@@ -1,0 +1,148 @@
+"""Tier-1 tests for schema inference, mirroring InferSchemaSuite.scala."""
+
+import pytest
+
+from tpu_tfrecord import infer, proto
+from tpu_tfrecord.infer import SchemaInferenceError, infer_schema, merge_type_maps
+from tpu_tfrecord.options import RecordType
+from tpu_tfrecord.proto import Example, Feature, FeatureList, SequenceExample
+from tpu_tfrecord.schema import (
+    ArrayType,
+    BinaryType,
+    FloatType,
+    LongType,
+    NullType,
+    StringType,
+)
+
+long_feature = Feature.int64_list([2**31 + 10])
+float_feature = Feature.float_list([10.0])
+str_feature = Feature.bytes_list([b"r1"])
+long_list = Feature.int64_list([-2, 20])
+float_list = Feature.float_list([2.5, 7.0])
+str_list = Feature.bytes_list([b"r1", b"r2"])
+empty_float_list = Feature(proto.FLOAT_LIST, [])
+
+
+class TestExampleInference:
+    """InferSchemaSuite.scala:39-81."""
+
+    def test_infer_from_examples(self):
+        example1 = Example(
+            features={
+                "LongFeature": long_feature,
+                "FloatFeature": float_feature,
+                "StrFeature": str_feature,
+                "LongList": long_feature,
+                "FloatList": float_feature,
+                "StrList": str_feature,
+                "MixedTypeList": long_list,
+            }
+        )
+        example2 = Example(
+            features={
+                "StrFeature": str_feature,
+                "LongList": long_list,
+                "FloatList": float_list,
+                "StrList": str_list,
+                "MixedTypeList": float_list,
+            }
+        )
+        schema = infer_schema([example1, example2], RecordType.EXAMPLE)
+        m = {f.name: f.data_type for f in schema}
+        assert len(schema) == 7
+        assert m["LongFeature"] == LongType()
+        assert m["FloatFeature"] == FloatType()
+        assert m["StrFeature"] == StringType()
+        assert m["LongList"] == ArrayType(LongType())
+        assert m["FloatList"] == ArrayType(FloatType())
+        assert m["StrList"] == ArrayType(StringType())
+        # long+float lists promote to Array(Float)
+        assert m["MixedTypeList"] == ArrayType(FloatType())
+
+    def test_infer_from_serialized_bytes(self):
+        ex = Example(features={"a": long_feature})
+        schema = infer_schema([proto.encode_example(ex)], RecordType.EXAMPLE)
+        assert {f.name: f.data_type for f in schema} == {"a": LongType()}
+
+    def test_scalar_string_promotion(self):
+        # long scalar + string scalar -> String (precedence 3 > 1)
+        e1 = Example(features={"x": long_feature})
+        e2 = Example(features={"x": str_feature})
+        schema = infer_schema([e1, e2], RecordType.EXAMPLE)
+        assert schema["x"].data_type == StringType()
+
+
+class TestSequenceExampleInference:
+    """InferSchemaSuite.scala:83-140."""
+
+    def test_infer_from_sequence_examples(self):
+        se1 = SequenceExample(
+            context={"FloatFeature": float_feature},
+            feature_lists={
+                "LongListOfLists": FeatureList([long_feature, long_list]),
+                "FloatListOfLists": FeatureList([float_feature, float_list]),
+                "StringListOfLists": FeatureList([str_feature]),
+                "MixedListOfLists": FeatureList([float_feature, str_list]),
+            },
+        )
+        se2 = SequenceExample(
+            feature_lists={
+                "LongListOfLists": FeatureList([long_list]),
+                "FloatListOfLists": FeatureList([float_feature]),
+                "StringListOfLists": FeatureList([str_feature]),
+                "MixedListOfLists": FeatureList([long_feature, str_feature]),
+            },
+        )
+        schema = infer_schema([se1, se2], RecordType.SEQUENCE_EXAMPLE)
+        m = {f.name: f.data_type for f in schema}
+        assert len(schema) == 5
+        assert m["FloatFeature"] == FloatType()
+        assert m["LongListOfLists"] == ArrayType(ArrayType(LongType()))
+        assert m["FloatListOfLists"] == ArrayType(ArrayType(FloatType()))
+        assert m["StringListOfLists"] == ArrayType(ArrayType(StringType()))
+        assert m["MixedListOfLists"] == ArrayType(ArrayType(StringType()))
+
+    def test_empty_feature_yields_null_type(self):
+        """InferSchemaSuite.scala:142-155."""
+        se = SequenceExample(context={"emptyFloatFeature": empty_float_list})
+        schema = infer_schema([se], RecordType.SEQUENCE_EXAMPLE)
+        assert len(schema) == 1
+        assert schema["emptyFloatFeature"].data_type == NullType()
+
+    def test_empty_then_concrete_promotes(self):
+        se1 = SequenceExample(context={"x": empty_float_list})
+        se2 = SequenceExample(context={"x": float_feature})
+        schema = infer_schema([se1, se2], RecordType.SEQUENCE_EXAMPLE)
+        assert schema["x"].data_type == FloatType()
+
+
+class TestMergeAndErrors:
+    def test_unsupported_record_type_raises(self):
+        with pytest.raises((SchemaInferenceError, ValueError)):
+            infer_schema([b"\x00"], "Bogus")
+
+    def test_byte_array_schema(self):
+        schema = infer_schema([], RecordType.BYTE_ARRAY)
+        assert schema.names == ["byteArray"]
+        assert schema["byteArray"].data_type == BinaryType()
+
+    def test_merge_type_maps_union_and_promotion(self):
+        """The distributed combOp (TensorFlowInferSchema.scala:120-127)."""
+        a = {"x": LongType(), "y": ArrayType(LongType()), "only_a": StringType()}
+        b = {"x": FloatType(), "y": ArrayType(FloatType()), "only_b": None}
+        merged = merge_type_maps(a, b)
+        assert merged["x"] == FloatType()
+        assert merged["y"] == ArrayType(FloatType())
+        assert merged["only_a"] == StringType()
+        assert merged["only_b"] is None
+
+    def test_infer_sample_limit(self):
+        e1 = Example(features={"x": long_feature})
+        e2 = Example(features={"x": str_feature})
+        schema = infer_schema([e1, e2], RecordType.EXAMPLE, limit=1)
+        assert schema["x"].data_type == LongType()
+
+    def test_wrong_message_type_raises(self):
+        with pytest.raises(SchemaInferenceError):
+            infer_schema([SequenceExample()], RecordType.EXAMPLE)

@@ -1,0 +1,211 @@
+"""Tier-1 tests for the hand-rolled Example/SequenceExample protobuf codec.
+
+Includes a cross-check against the official protobuf runtime (compiling
+tensorflow's example.proto/feature.proto with protoc at test time) so our
+wire bytes are provably interoperable with TensorFlow readers.
+"""
+
+import importlib.util
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tpu_tfrecord import proto
+from tpu_tfrecord.proto import (
+    BYTES_LIST,
+    FLOAT_LIST,
+    INT64_LIST,
+    Example,
+    Feature,
+    FeatureList,
+    SequenceExample,
+)
+
+
+def make_example():
+    return Example(
+        features={
+            "long": Feature.int64_list([7]),
+            "longs": Feature.int64_list([-2, 20, 2**62, -(2**62)]),
+            "float": Feature.float_list([2.5]),
+            "floats": Feature.float_list([1.5, -3.25, 1e30]),
+            "bytes": Feature.bytes_list([b"r1"]),
+            "strs": Feature.bytes_list(["héllo".encode("utf-8"), b"", b"\x00\xff"]),
+            "empty_int": Feature(INT64_LIST, []),
+            "empty_float": Feature(FLOAT_LIST, []),
+            "empty_bytes": Feature(BYTES_LIST, []),
+        }
+    )
+
+
+def make_sequence_example():
+    return SequenceExample(
+        context={"id": Feature.int64_list([42]), "name": Feature.bytes_list([b"seq"])},
+        feature_lists={
+            "frames": FeatureList(
+                [Feature.float_list([1.0, 2.0]), Feature.float_list([3.0])]
+            ),
+            "tokens": FeatureList([Feature.bytes_list([b"a", b"b"])]),
+            "empty": FeatureList([]),
+        },
+    )
+
+
+class TestRoundTrip:
+    def test_example_round_trip(self):
+        ex = make_example()
+        parsed = proto.parse_example(proto.encode_example(ex))
+        assert set(parsed.features) == set(ex.features)
+        for name, feat in ex.features.items():
+            got = parsed.features[name]
+            assert got.kind == feat.kind, name
+            if feat.kind == FLOAT_LIST:
+                np.testing.assert_allclose(got.values, np.float32(feat.values))
+            else:
+                assert list(got.values) == list(feat.values), name
+
+    def test_sequence_example_round_trip(self):
+        se = make_sequence_example()
+        parsed = proto.parse_sequence_example(proto.encode_sequence_example(se))
+        assert set(parsed.context) == {"id", "name"}
+        assert parsed.context["id"].values == [42]
+        assert set(parsed.feature_lists) == {"frames", "tokens", "empty"}
+        frames = parsed.feature_lists["frames"].feature
+        assert [list(np.float32(f.values)) for f in frames] == [[1.0, 2.0], [3.0]]
+        assert parsed.feature_lists["tokens"].feature[0].values == [b"a", b"b"]
+        assert parsed.feature_lists["empty"].feature == []
+
+    def test_empty_example(self):
+        parsed = proto.parse_example(proto.encode_example(Example()))
+        assert parsed.features == {}
+
+    def test_deterministic_encoding(self):
+        e1 = Example(features={"b": Feature.int64_list([1]), "a": Feature.int64_list([2])})
+        e2 = Example(features={"a": Feature.int64_list([2]), "b": Feature.int64_list([1])})
+        assert proto.encode_example(e1) == proto.encode_example(e2)
+
+    def test_negative_int64_ten_bytes(self):
+        ex = Example(features={"v": Feature.int64_list([-1])})
+        parsed = proto.parse_example(proto.encode_example(ex))
+        assert parsed.features["v"].values == [-1]
+
+    def test_unpacked_varints_accepted(self):
+        # Hand-build an Int64List with UNPACKED encoding (proto2-style);
+        # readers must accept both packed and unpacked.
+        int64_list = bytes([0x08, 0x05, 0x08, 0x07])  # field 1 varint 5, varint 7
+        feature = bytes([0x1A, len(int64_list)]) + int64_list  # field 3 LEN
+        entry = bytes([0x0A, 1, ord("v"), 0x12, len(feature)]) + feature
+        features = bytes([0x0A, len(entry)]) + entry
+        example = bytes([0x0A, len(features)]) + features
+        parsed = proto.parse_example(example)
+        assert parsed.features["v"].values == [5, 7]
+
+    def test_unpacked_floats_accepted(self):
+        f = struct.pack("<f", 1.5)
+        float_list = bytes([0x0D]) + f  # field 1 wire type I32
+        feature = bytes([0x12, len(float_list)]) + float_list  # field 2 LEN
+        entry = bytes([0x0A, 1, ord("f"), 0x12, len(feature)]) + feature
+        features = bytes([0x0A, len(entry)]) + entry
+        example = bytes([0x0A, len(features)]) + features
+        parsed = proto.parse_example(example)
+        assert parsed.features["f"].values == [1.5]
+
+    def test_truncated_raises(self):
+        data = proto.encode_example(make_example())
+        with pytest.raises(proto.ProtoDecodeError):
+            proto.parse_example(data[:-3])
+
+    def test_kind_names(self):
+        assert Feature.int64_list([1]).kind_name == "int64_list"
+        assert Feature.float_list([1.0]).kind_name == "float_list"
+        assert Feature.bytes_list([b"x"]).kind_name == "bytes_list"
+        assert Feature().kind_name is None
+
+
+# ---------------------------------------------------------------------------
+# Cross-validation against the official protobuf runtime
+# ---------------------------------------------------------------------------
+
+_FEATURE_PROTO = """
+syntax = "proto3";
+package tfr_test;
+message BytesList { repeated bytes value = 1; }
+message FloatList { repeated float value = 1 [packed = true]; }
+message Int64List { repeated int64 value = 1 [packed = true]; }
+message Feature {
+  oneof kind {
+    BytesList bytes_list = 1;
+    FloatList float_list = 2;
+    Int64List int64_list = 3;
+  }
+}
+message Features { map<string, Feature> feature = 1; }
+message FeatureList { repeated Feature feature = 1; }
+message FeatureLists { map<string, FeatureList> feature_list = 1; }
+message Example { Features features = 1; }
+message SequenceExample { Features context = 1; FeatureLists feature_lists = 2; }
+"""
+
+
+@pytest.fixture(scope="module")
+def pb2(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("protos")
+    proto_path = tmp / "tfr_test.proto"
+    proto_path.write_text(_FEATURE_PROTO)
+    try:
+        subprocess.run(
+            ["protoc", f"--python_out={tmp}", f"--proto_path={tmp}", str(proto_path)],
+            check=True,
+            capture_output=True,
+        )
+    except (OSError, subprocess.CalledProcessError) as e:  # pragma: no cover
+        pytest.skip(f"protoc unavailable: {e}")
+    spec = importlib.util.spec_from_file_location("tfr_test_pb2", tmp / "tfr_test_pb2.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["tfr_test_pb2"] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except Exception as e:  # pragma: no cover
+        pytest.skip(f"generated pb2 incompatible with runtime: {e}")
+    return mod
+
+
+class TestProtobufInterop:
+    def test_our_bytes_parse_with_official_runtime(self, pb2):
+        data = proto.encode_example(make_example())
+        official = pb2.Example()
+        official.ParseFromString(data)
+        fm = official.features.feature
+        assert list(fm["long"].int64_list.value) == [7]
+        assert list(fm["longs"].int64_list.value) == [-2, 20, 2**62, -(2**62)]
+        np.testing.assert_allclose(
+            list(fm["floats"].float_list.value), np.float32([1.5, -3.25, 1e30])
+        )
+        assert list(fm["strs"].bytes_list.value) == ["héllo".encode(), b"", b"\x00\xff"]
+        assert fm["empty_int"].WhichOneof("kind") == "int64_list"
+
+    def test_official_bytes_parse_with_ours(self, pb2):
+        official = pb2.Example()
+        official.features.feature["x"].int64_list.value.extend([1, -5, 2**40])
+        official.features.feature["y"].float_list.value.extend([0.5, 7.0])
+        official.features.feature["z"].bytes_list.value.append(b"blob")
+        parsed = proto.parse_example(official.SerializeToString())
+        assert parsed.features["x"].values == [1, -5, 2**40]
+        assert parsed.features["y"].values == [0.5, 7.0]
+        assert parsed.features["z"].values == [b"blob"]
+
+    def test_sequence_example_interop(self, pb2):
+        data = proto.encode_sequence_example(make_sequence_example())
+        official = pb2.SequenceExample()
+        official.ParseFromString(data)
+        assert list(official.context.feature["id"].int64_list.value) == [42]
+        frames = official.feature_lists.feature_list["frames"].feature
+        assert [list(f.float_list.value) for f in frames] == [[1.0, 2.0], [3.0]]
+        # and back
+        parsed = proto.parse_sequence_example(official.SerializeToString())
+        assert parsed.context["id"].values == [42]
+        assert len(parsed.feature_lists["frames"].feature) == 2

@@ -1,0 +1,79 @@
+"""Tier-1 tests for the schema model (StructType equivalent)."""
+
+import numpy as np
+import pytest
+
+from tpu_tfrecord.schema import (
+    ArrayType,
+    BinaryType,
+    DecimalType,
+    DoubleType,
+    FloatType,
+    IntegerType,
+    LongType,
+    NullType,
+    StringType,
+    StructField,
+    StructType,
+    numpy_dtype,
+)
+
+
+def full_schema():
+    return StructType(
+        [
+            StructField("i", IntegerType(), False),
+            StructField("l", LongType()),
+            StructField("f", FloatType()),
+            StructField("d", DoubleType()),
+            StructField("dec", DecimalType()),
+            StructField("s", StringType()),
+            StructField("b", BinaryType()),
+            StructField("al", ArrayType(LongType())),
+            StructField("aas", ArrayType(ArrayType(StringType()))),
+            StructField("n", NullType()),
+        ]
+    )
+
+
+class TestStructType:
+    def test_json_round_trip(self):
+        schema = full_schema()
+        assert StructType.from_json(schema.json()) == schema
+
+    def test_field_lookup(self):
+        schema = full_schema()
+        assert schema.field_index("f") == 2
+        assert schema["f"].data_type == FloatType()
+        assert "f" in schema and "zzz" not in schema
+        assert schema.names[0] == "i"
+
+    def test_duplicate_names_rejected(self):
+        with pytest.raises(ValueError):
+            StructType([StructField("x", LongType()), StructField("x", FloatType())])
+
+    def test_equality_ignores_contains_null_like_reference_lattice(self):
+        assert ArrayType(LongType(), True) == ArrayType(LongType(), False)
+        assert ArrayType(LongType()) != ArrayType(FloatType())
+
+    def test_add_select_drop(self):
+        schema = StructType([StructField("a", LongType())])
+        schema2 = schema.add("b", FloatType(), nullable=False)
+        assert schema2.names == ["a", "b"]
+        assert not schema2["b"].nullable
+        assert schema2.select(["b"]).names == ["b"]
+        assert schema2.drop(["a"]).names == ["b"]
+
+    def test_decimal_identity(self):
+        assert DecimalType() == DecimalType(10, 0)
+        assert DecimalType(20, 2) != DecimalType()
+        assert DecimalType(20, 2).simple_string() == "decimal(20,2)"
+
+    def test_numpy_dtypes(self):
+        assert numpy_dtype(IntegerType()) == np.int32
+        assert numpy_dtype(LongType()) == np.int64
+        assert numpy_dtype(FloatType()) == np.float32
+        assert numpy_dtype(DoubleType()) == np.float64
+        assert numpy_dtype(DecimalType()) == np.float64
+        assert numpy_dtype(StringType()) is None
+        assert numpy_dtype(ArrayType(FloatType())) == np.float32

@@ -1,0 +1,340 @@
+"""Tier-1 tests for the row<->record codec, mirroring the reference's
+TFRecordSerializerTest.scala and TFRecordDeserializerTest.scala matrix."""
+
+import decimal
+
+import numpy as np
+import pytest
+
+from tpu_tfrecord import proto
+from tpu_tfrecord.options import RecordType
+from tpu_tfrecord.proto import BYTES_LIST, FLOAT_LIST, INT64_LIST, Example, Feature, FeatureList, SequenceExample
+from tpu_tfrecord.schema import (
+    ArrayType,
+    BinaryType,
+    DecimalType,
+    DoubleType,
+    FloatType,
+    IntegerType,
+    LongType,
+    StringType,
+    StructField,
+    StructType,
+)
+from tpu_tfrecord.serde import (
+    NullValueError,
+    TFRecordDeserializer,
+    TFRecordSerializer,
+    UnsupportedDataTypeError,
+    decode_record,
+    encode_row,
+)
+
+COMPLEX_SCHEMA = StructType(
+    [
+        StructField("IntegerCol", IntegerType()),
+        StructField("LongCol", LongType()),
+        StructField("FloatCol", FloatType()),
+        StructField("DoubleCol", DoubleType()),
+        StructField("DecimalCol", DecimalType()),
+        StructField("StrCol", StringType()),
+        StructField("BinCol", BinaryType()),
+        StructField("IntListCol", ArrayType(IntegerType())),
+        StructField("LongListCol", ArrayType(LongType())),
+        StructField("FloatListCol", ArrayType(FloatType())),
+        StructField("DoubleListCol", ArrayType(DoubleType())),
+        StructField("DecimalListCol", ArrayType(DecimalType())),
+        StructField("StrListCol", ArrayType(StringType())),
+        StructField("BinListCol", ArrayType(BinaryType())),
+    ]
+)
+
+COMPLEX_ROW = [
+    1,
+    23,
+    10.0,
+    14.0,
+    decimal.Decimal("2.5"),
+    "r1",
+    b"\x01\x02",
+    [1, 2],
+    [3, 4],
+    [2.5, 5.0],
+    [3.0, 7.5],
+    [decimal.Decimal("1.5"), decimal.Decimal("2.0")],
+    ["a", "b"],
+    [b"x", b"yz"],
+]
+
+
+class TestSerializeExample:
+    """Mirrors TFRecordSerializerTest.scala:46-141."""
+
+    def test_complex_row_to_example(self):
+        ser = TFRecordSerializer(COMPLEX_SCHEMA)
+        ex = ser.serialize_example(COMPLEX_ROW)
+        f = ex.features
+        assert f["IntegerCol"].kind == INT64_LIST and f["IntegerCol"].values == [1]
+        assert f["LongCol"].values == [23]
+        assert f["FloatCol"].kind == FLOAT_LIST and f["FloatCol"].values == [10.0]
+        assert f["DoubleCol"].kind == FLOAT_LIST and f["DoubleCol"].values == [14.0]
+        assert f["DecimalCol"].values == [2.5]
+        assert f["StrCol"].kind == BYTES_LIST and f["StrCol"].values == [b"r1"]
+        assert f["BinCol"].values == [b"\x01\x02"]
+        assert f["IntListCol"].values == [1, 2]
+        assert f["LongListCol"].values == [3, 4]
+        assert f["FloatListCol"].values == [2.5, 5.0]
+        assert f["DoubleListCol"].values == [3.0, 7.5]
+        assert f["DecimalListCol"].values == [1.5, 2.0]
+        assert f["StrListCol"].values == [b"a", b"b"]
+        assert f["BinListCol"].values == [b"x", b"yz"]
+
+    def test_double_downcast_to_float32(self):
+        schema = StructType([StructField("d", DoubleType())])
+        ex = TFRecordSerializer(schema).serialize_example([1.0 + 1e-12])
+        assert ex.features["d"].values == [np.float32(1.0 + 1e-12)]
+
+    def test_null_nullable_field_omitted(self):
+        """TFRecordSerializerTest.scala:247-288."""
+        ser = TFRecordSerializer(COMPLEX_SCHEMA)
+        row = list(COMPLEX_ROW)
+        row[2] = None
+        ex = ser.serialize_example(row)
+        assert "FloatCol" not in ex.features
+        assert "LongCol" in ex.features
+
+    def test_null_non_nullable_raises(self):
+        """TFRecordSerializerTest.scala:229-245."""
+        schema = StructType([StructField("x", LongType(), nullable=False)])
+        with pytest.raises(NullValueError):
+            TFRecordSerializer(schema).serialize_example([None])
+
+    def test_unsupported_type_raises_at_construction(self):
+        """TFRecordSerializerTest.scala:290-299."""
+
+        class BogusType:
+            pass
+
+        schema = StructType.__new__(StructType)
+        schema.fields = (StructField("bad", BogusType(), True),)  # type: ignore[arg-type]
+        schema._index = {"bad": 0}
+        with pytest.raises(UnsupportedDataTypeError):
+            TFRecordSerializer(schema)
+
+    def test_nested_array_in_example_raises(self):
+        schema = StructType([StructField("m", ArrayType(ArrayType(LongType())))])
+        ser = TFRecordSerializer(schema)
+        with pytest.raises(UnsupportedDataTypeError):
+            ser.serialize_example([[[1, 2], [3]]])
+
+    def test_null_array_element_raises(self):
+        schema = StructType([StructField("a", ArrayType(StringType()))])
+        with pytest.raises(NullValueError):
+            TFRecordSerializer(schema).serialize_example([["ok", None]])
+
+    def test_byte_array_passthrough(self):
+        schema = StructType([StructField("byteArray", BinaryType())])
+        ser = TFRecordSerializer(schema)
+        assert ser.serialize_byte_array([b"raw-proto-bytes"]) == b"raw-proto-bytes"
+        with pytest.raises(TypeError):
+            ser.serialize_byte_array(["not-bytes"])
+
+
+class TestSerializeSequenceExample:
+    """Mirrors TFRecordSerializerTest.scala:143-227."""
+
+    SCHEMA = StructType(
+        [
+            StructField("id", LongType()),
+            StructField("name", StringType()),
+            StructField("LongArrayOfArray", ArrayType(ArrayType(LongType()))),
+            StructField("FloatArrayOfArray", ArrayType(ArrayType(FloatType()))),
+            StructField("DoubleArrayOfArray", ArrayType(ArrayType(DoubleType()))),
+            StructField("DecimalArrayOfArray", ArrayType(ArrayType(DecimalType()))),
+            StructField("StrArrayOfArray", ArrayType(ArrayType(StringType()))),
+            StructField("BinArrayOfArray", ArrayType(ArrayType(BinaryType()))),
+        ]
+    )
+
+    ROW = [
+        7,
+        "seq",
+        [[1, 2], [3]],
+        [[1.5], [2.5, 3.5]],
+        [[4.0]],
+        [[decimal.Decimal("0.5")]],
+        [["a"], ["b", "c"]],
+        [[b"z"]],
+    ]
+
+    def test_context_vs_feature_lists_split(self):
+        se = TFRecordSerializer(self.SCHEMA).serialize_sequence_example(self.ROW)
+        assert set(se.context) == {"id", "name"}
+        assert set(se.feature_lists) == {
+            "LongArrayOfArray",
+            "FloatArrayOfArray",
+            "DoubleArrayOfArray",
+            "DecimalArrayOfArray",
+            "StrArrayOfArray",
+            "BinArrayOfArray",
+        }
+        ll = se.feature_lists["LongArrayOfArray"].feature
+        assert [f.values for f in ll] == [[1, 2], [3]]
+        fl = se.feature_lists["FloatArrayOfArray"].feature
+        assert [f.values for f in fl] == [[1.5], [2.5, 3.5]]
+        sl = se.feature_lists["StrArrayOfArray"].feature
+        assert [f.values for f in sl] == [[b"a"], [b"b", b"c"]]
+
+    def test_scalar_arrays_go_to_context(self):
+        schema = StructType([StructField("arr", ArrayType(FloatType()))])
+        se = TFRecordSerializer(schema).serialize_sequence_example([[1.0, 2.0]])
+        assert "arr" in se.context
+        assert se.feature_lists == {}
+
+
+def float_feature(vals):
+    return Feature.float_list(vals)
+
+
+class TestDeserializeExample:
+    """Mirrors TFRecordDeserializerTest.scala:61-111, 164-253."""
+
+    def test_complex_example_to_row(self):
+        ser = TFRecordSerializer(COMPLEX_SCHEMA)
+        de = TFRecordDeserializer(COMPLEX_SCHEMA)
+        row = de.deserialize_example(ser.serialize_example(COMPLEX_ROW))
+        assert row[0] == 1
+        assert row[1] == 23
+        assert row[2] == 10.0
+        assert row[3] == 14.0
+        assert float(row[4]) == 2.5 and isinstance(row[4], decimal.Decimal)
+        assert row[5] == "r1"
+        assert row[6] == b"\x01\x02"
+        assert row[7] == [1, 2]
+        assert row[8] == [3, 4]
+        assert row[9] == [2.5, 5.0]
+        assert row[10] == [3.0, 7.5]
+        assert [float(v) for v in row[11]] == [1.5, 2.0]
+        assert row[12] == ["a", "b"]
+        assert row[13] == [b"x", b"yz"]
+
+    def test_missing_nullable_is_none(self):
+        schema = StructType([StructField("absent", FloatType())])
+        row = TFRecordDeserializer(schema).deserialize_example(Example())
+        assert row == [None]
+
+    def test_missing_non_nullable_raises(self):
+        schema = StructType([StructField("absent", FloatType(), nullable=False)])
+        with pytest.raises(NullValueError):
+            TFRecordDeserializer(schema).deserialize_example(Example())
+
+    def test_kind_mismatch_raises(self):
+        schema = StructType([StructField("x", FloatType())])
+        ex = Example(features={"x": Feature.int64_list([3])})
+        with pytest.raises(ValueError, match="FloatList"):
+            TFRecordDeserializer(schema).deserialize_example(ex)
+
+    def test_int_truncation_matches_scala_toInt(self):
+        schema = StructType([StructField("x", IntegerType())])
+        ex = Example(features={"x": Feature.int64_list([2**31 + 10])})
+        row = TFRecordDeserializer(schema).deserialize_example(ex)
+        assert row[0] == -(2**31) + 10
+
+    def test_state_leak_regression(self):
+        """Rows must not inherit values from previous records
+        (TFRecordDeserializerTest.scala:313-346)."""
+        schema = StructType([StructField("a", LongType()), StructField("b", StringType())])
+        de = TFRecordDeserializer(schema)
+        full = Example(features={"a": Feature.int64_list([1]), "b": Feature.bytes_list([b"x"])})
+        partial = Example(features={"a": Feature.int64_list([2])})
+        assert de.deserialize_example(full) == [1, "x"]
+        assert de.deserialize_example(partial) == [2, None]
+
+    def test_unsupported_type_raises_at_construction(self):
+        class BogusType:
+            pass
+
+        schema = StructType.__new__(StructType)
+        schema.fields = (StructField("bad", BogusType(), True),)  # type: ignore[arg-type]
+        schema._index = {"bad": 0}
+        with pytest.raises(UnsupportedDataTypeError):
+            TFRecordDeserializer(schema)
+
+    def test_byte_array(self):
+        de = TFRecordDeserializer(StructType([StructField("byteArray", BinaryType())]))
+        assert de.deserialize_byte_array(b"\x00\x01") == [b"\x00\x01"]
+
+
+class TestDeserializeSequenceExample:
+    """Mirrors TFRecordDeserializerTest.scala:113-162."""
+
+    def test_mixed_context_and_feature_lists(self):
+        schema = StructType(
+            [
+                StructField("id", LongType()),
+                StructField("frames", ArrayType(ArrayType(FloatType()))),
+                StructField("scalar_list", ArrayType(LongType())),
+            ]
+        )
+        se = SequenceExample(
+            context={"id": Feature.int64_list([9])},
+            feature_lists={
+                "frames": FeatureList([float_feature([1.0, 2.0]), float_feature([3.0])]),
+                "scalar_list": FeatureList(
+                    [Feature.int64_list([5]), Feature.int64_list([6])]
+                ),
+            },
+        )
+        row = TFRecordDeserializer(schema).deserialize_sequence_example(se)
+        assert row[0] == 9
+        assert row[1] == [[1.0, 2.0], [3.0]]
+        # FeatureList of scalar features -> ArrayType(Long) via scalar writer
+        assert row[2] == [5, 6]
+
+    def test_context_takes_priority(self):
+        schema = StructType([StructField("x", ArrayType(LongType()))])
+        se = SequenceExample(
+            context={"x": Feature.int64_list([1, 2])},
+            feature_lists={"x": FeatureList([Feature.int64_list([9])])},
+        )
+        row = TFRecordDeserializer(schema).deserialize_sequence_example(se)
+        assert row[0] == [1, 2]
+
+    def test_missing_non_nullable_raises(self):
+        schema = StructType([StructField("gone", ArrayType(LongType()), nullable=False)])
+        with pytest.raises(NullValueError):
+            TFRecordDeserializer(schema).deserialize_sequence_example(SequenceExample())
+
+
+class TestRecordLevelHelpers:
+    @pytest.mark.parametrize(
+        "record_type,schema,row",
+        [
+            (RecordType.EXAMPLE, COMPLEX_SCHEMA, COMPLEX_ROW),
+            (
+                RecordType.SEQUENCE_EXAMPLE,
+                TestSerializeSequenceExample.SCHEMA,
+                TestSerializeSequenceExample.ROW,
+            ),
+            (
+                RecordType.BYTE_ARRAY,
+                StructType([StructField("byteArray", BinaryType())]),
+                [b"opaque"],
+            ),
+        ],
+    )
+    def test_bytes_round_trip(self, record_type, schema, row):
+        ser = TFRecordSerializer(schema)
+        de = TFRecordDeserializer(schema)
+        data = encode_row(ser, record_type, row)
+        back = decode_record(de, record_type, data)
+        if record_type == RecordType.BYTE_ARRAY:
+            assert back == row
+        else:
+            for got, want, field in zip(back, row, schema):
+                if isinstance(want, decimal.Decimal):
+                    assert float(got) == pytest.approx(float(want))
+                elif isinstance(want, list) and want and isinstance(want[0], decimal.Decimal):
+                    assert [float(v) for v in got] == pytest.approx([float(v) for v in want])
+                else:
+                    assert got == want, field.name

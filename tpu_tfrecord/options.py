@@ -1,0 +1,107 @@
+"""Typed, validated options — replacing the reference's stringly-typed map.
+
+The reference threads a Map[String,String] from the DataFrame API and re-reads
+``recordType`` independently at three sites with per-site validation
+(DefaultSource.scala:35, TFRecordFileReader.scala:22,
+TFRecordOutputWriter.scala:22). Here options are parsed and validated ONCE
+into an immutable dataclass; being a plain picklable value it also plays the
+role of the reference's SerializableConfiguration (DefaultSource.scala:145-182)
+— the thing shipped from the coordinator to worker processes.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, Mapping, Optional
+
+from tpu_tfrecord import wire
+from tpu_tfrecord.schema import StructType
+
+
+class RecordType(enum.Enum):
+    EXAMPLE = "Example"
+    SEQUENCE_EXAMPLE = "SequenceExample"
+    BYTE_ARRAY = "ByteArray"
+
+    @staticmethod
+    def parse(value: "RecordType | str | None") -> "RecordType":
+        """Parse with the reference's exact accepted spellings and default
+        (``Example``; unknown value -> error, ref DefaultSource.scala:67-68)."""
+        if value is None or value == "":
+            return RecordType.EXAMPLE
+        if isinstance(value, RecordType):
+            return value
+        for rt in RecordType:
+            if rt.value == value:
+                return rt
+        raise ValueError(
+            f"Unsupported recordType {value}: recordType can be ByteArray, "
+            "Example or SequenceExample"
+        )
+
+
+@dataclass(frozen=True)
+class TFRecordOptions:
+    """All knobs for a read or write, validated at construction.
+
+    Attributes mirror the reference's option vocabulary (README.md "Features"):
+      - record_type: Example | SequenceExample | ByteArray
+      - codec: None | 'gzip' | 'deflate' (write-side; read infers by extension)
+      - schema: optional user-provided StructType (skips inference)
+    plus TPU-native additions:
+      - verify_crc: validate record CRCs on read
+      - infer_sample_limit: cap records scanned per file during schema
+        inference (the reference scans a whole file, README.md:73-74 calls the
+        extra pass "expensive" — this bounds it; None = full file parity).
+    """
+
+    record_type: RecordType = RecordType.EXAMPLE
+    codec: Optional[str] = None
+    schema: Optional[StructType] = None
+    verify_crc: bool = True
+    infer_sample_limit: Optional[int] = None
+    extra: Dict[str, Any] = field(default_factory=dict, compare=False)
+
+    @staticmethod
+    def from_map(options: Optional[Mapping[str, Any]] = None, **kwargs: Any) -> "TFRecordOptions":
+        """Build from a string-keyed map, accepting the reference's spellings
+        (``recordType``, ``codec``) as well as snake_case."""
+        merged: Dict[str, Any] = dict(options or {})
+        merged.update(kwargs)
+        record_type = RecordType.parse(
+            merged.pop("recordType", merged.pop("record_type", None))
+        )
+        codec = wire.normalize_codec(merged.pop("codec", None))
+        schema = merged.pop("schema", None)
+        if isinstance(schema, (str, dict)):
+            schema = StructType.from_json(schema)
+        verify_crc = _parse_bool(merged.pop("verify_crc", merged.pop("verifyCrc", True)))
+        limit = merged.pop("infer_sample_limit", merged.pop("inferSampleLimit", None))
+        if limit is not None:
+            limit = int(limit)
+            if limit <= 0:
+                raise ValueError("infer_sample_limit must be positive")
+        return TFRecordOptions(
+            record_type=record_type,
+            codec=codec,
+            schema=schema,
+            verify_crc=verify_crc,
+            infer_sample_limit=limit,
+            extra=merged,
+        )
+
+    def with_schema(self, schema: StructType) -> "TFRecordOptions":
+        return replace(self, schema=schema)
+
+    def file_extension(self) -> str:
+        """'.tfrecord' + codec suffix (ref DefaultSource.scala:112-114)."""
+        return ".tfrecord" + wire.codec_extension(self.codec)
+
+
+def _parse_bool(value: Any) -> bool:
+    if isinstance(value, bool):
+        return value
+    if isinstance(value, str):
+        return value.strip().lower() in ("1", "true", "yes")
+    return bool(value)

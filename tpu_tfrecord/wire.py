@@ -1,0 +1,363 @@
+"""TFRecord wire format: record framing with masked CRC32C checksums.
+
+Re-implements natively what the reference delegates to the shaded JVM library
+``org.tensorflow:tensorflow-hadoop`` (``TFRecordFileInputFormat`` /
+``TFRecordWriter``; see reference pom.xml:372-376 and SURVEY.md §2.8).
+
+Frame layout per record::
+
+    uint64  length        (little-endian)
+    uint32  masked_crc32c(length bytes)
+    bytes   data[length]
+    uint32  masked_crc32c(data)
+
+This module is the pure-Python reference implementation; `tpu_tfrecord._native`
+provides a C++ fast path (SSE4.2 / slicing-by-8 CRC32C, zero-copy frame scan)
+that this module transparently uses when the extension is built.
+"""
+
+from __future__ import annotations
+
+import gzip
+import io
+import os
+import struct
+import zlib
+from typing import BinaryIO, Iterator, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# CRC32C (Castagnoli, polynomial 0x1EDC6F41, reflected 0x82F63B78)
+# ---------------------------------------------------------------------------
+
+_POLY = 0x82F63B78
+
+
+def _make_tables(n: int = 8) -> List[List[int]]:
+    """Slicing-by-N tables: table[0] is the plain byte-at-a-time table."""
+    t0 = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ (_POLY if crc & 1 else 0)
+        t0.append(crc)
+    tables = [t0]
+    for k in range(1, n):
+        prev = tables[k - 1]
+        tk = []
+        for i in range(256):
+            c = prev[i]
+            tk.append((c >> 8) ^ t0[c & 0xFF])
+        tables.append(tk)
+    return tables
+
+
+_TABLES = _make_tables(8)
+_T0, _T1, _T2, _T3, _T4, _T5, _T6, _T7 = _TABLES
+
+
+def crc32c_py(data: bytes, crc: int = 0) -> int:
+    """Pure-Python CRC32C (slicing-by-8). Correct but slow; C++ is the fast path."""
+    crc = crc ^ 0xFFFFFFFF
+    n = len(data)
+    i = 0
+    # Process 8 bytes at a time via slicing-by-8.
+    end8 = n - (n % 8)
+    while i < end8:
+        b0 = data[i] ^ (crc & 0xFF)
+        b1 = data[i + 1] ^ ((crc >> 8) & 0xFF)
+        b2 = data[i + 2] ^ ((crc >> 16) & 0xFF)
+        b3 = data[i + 3] ^ ((crc >> 24) & 0xFF)
+        crc = (
+            _T7[b0]
+            ^ _T6[b1]
+            ^ _T5[b2]
+            ^ _T4[b3]
+            ^ _T3[data[i + 4]]
+            ^ _T2[data[i + 5]]
+            ^ _T1[data[i + 6]]
+            ^ _T0[data[i + 7]]
+        )
+        i += 8
+    while i < n:
+        crc = (crc >> 8) ^ _T0[(crc ^ data[i]) & 0xFF]
+        i += 1
+    return crc ^ 0xFFFFFFFF
+
+
+# Swapped in by tpu_tfrecord._native when the C++ extension is available.
+crc32c = crc32c_py
+
+_MASK_DELTA = 0xA282EAD8
+
+
+def masked_crc32c(data: bytes) -> int:
+    """The TFRecord 'masked' CRC: rotate right by 15 and add a constant."""
+    crc = crc32c(data)
+    return ((((crc >> 15) | (crc << 17)) & 0xFFFFFFFF) + _MASK_DELTA) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# Compression codecs
+# ---------------------------------------------------------------------------
+#
+# The reference maps a `codec` option onto Hadoop compression-codec class names
+# (DefaultSource.scala:95-102) and infers the read codec from the file
+# extension (Hadoop behavior). We support the same codecs Hadoop's
+# GzipCodec/DefaultCodec provide, keyed by short name, Hadoop class name, or
+# file extension.
+
+_CODEC_ALIASES = {
+    "": None,
+    "none": None,
+    "uncompressed": None,
+    "gzip": "gzip",
+    "gz": "gzip",
+    "org.apache.hadoop.io.compress.gzipcodec": "gzip",
+    "deflate": "deflate",
+    "zlib": "deflate",
+    "org.apache.hadoop.io.compress.defaultcodec": "deflate",
+}
+
+_CODEC_EXTENSIONS = {"gzip": ".gz", "deflate": ".deflate"}
+
+
+def normalize_codec(codec: Optional[str]) -> Optional[str]:
+    """Resolve a user-supplied codec name to a canonical codec or raise."""
+    if codec is None:
+        return None
+    key = codec.strip().lower()
+    if key in _CODEC_ALIASES:
+        return _CODEC_ALIASES[key]
+    raise ValueError(
+        f"Unsupported codec {codec!r}: supported codecs are 'gzip' and "
+        "'deflate' (or their Hadoop class names)"
+    )
+
+
+def codec_extension(codec: Optional[str]) -> str:
+    """File-name suffix appended after '.tfrecord' (ref DefaultSource.scala:112-114)."""
+    codec = normalize_codec(codec)
+    return _CODEC_EXTENSIONS.get(codec, "") if codec else ""
+
+
+def codec_from_path(path: str) -> Optional[str]:
+    """Infer the codec from a file extension, like Hadoop's codec factory."""
+    lower = path.lower()
+    if lower.endswith(".gz") or lower.endswith(".gzip"):
+        return "gzip"
+    if lower.endswith(".deflate") or lower.endswith(".zlib"):
+        return "deflate"
+    return None
+
+
+def open_compressed(path: str, mode: str, codec: Optional[str]) -> BinaryIO:
+    codec = normalize_codec(codec)
+    if codec == "gzip":
+        return gzip.open(path, mode)  # type: ignore[return-value]
+    if codec == "deflate":
+        return _DeflateFile(path, mode)
+    return open(path, mode)  # noqa: SIM115
+
+
+class _DeflateFile(io.RawIOBase):
+    """zlib-wrapped file (Hadoop DefaultCodec writes raw zlib streams)."""
+
+    def __init__(self, path: str, mode: str):
+        super().__init__()
+        self._mode = mode
+        if "w" in mode:
+            self._fh = open(path, "wb")
+            self._compress = zlib.compressobj()
+            self._buffer = None
+        else:
+            with open(path, "rb") as fh:
+                self._buffer = io.BytesIO(zlib.decompress(fh.read()))
+            self._fh = None
+            self._compress = None
+
+    def readable(self) -> bool:
+        return self._buffer is not None
+
+    def writable(self) -> bool:
+        return self._compress is not None
+
+    def read(self, size: int = -1) -> bytes:
+        return self._buffer.read(size)
+
+    def readinto(self, b) -> int:
+        data = self._buffer.read(len(b))
+        b[: len(data)] = data
+        return len(data)
+
+    def write(self, data) -> int:
+        self._fh.write(self._compress.compress(bytes(data)))
+        return len(data)
+
+    def close(self) -> None:
+        if not self.closed:
+            if self._compress is not None:
+                self._fh.write(self._compress.flush())
+                self._fh.close()
+            super().close()
+
+
+# ---------------------------------------------------------------------------
+# Record-level framing
+# ---------------------------------------------------------------------------
+
+_LEN_STRUCT = struct.Struct("<Q")
+_CRC_STRUCT = struct.Struct("<I")
+HEADER_BYTES = 12  # 8-byte length + 4-byte length crc
+FOOTER_BYTES = 4  # 4-byte data crc
+
+
+class TFRecordCorruptionError(IOError):
+    """Raised when framing or CRC validation fails."""
+
+
+def encode_record(data: bytes) -> bytes:
+    """Frame one record (length + masked length CRC + data + masked data CRC)."""
+    header = _LEN_STRUCT.pack(len(data))
+    return b"".join(
+        (
+            header,
+            _CRC_STRUCT.pack(masked_crc32c(header)),
+            data,
+            _CRC_STRUCT.pack(masked_crc32c(data)),
+        )
+    )
+
+
+class RecordWriter:
+    """Streaming TFRecord writer over a binary file object.
+
+    TPU-native counterpart of the shaded ``TFRecordWriter`` used at reference
+    TFRecordOutputWriter.scala:21,37.
+    """
+
+    def __init__(self, fh: BinaryIO):
+        self._fh = fh
+        self.records_written = 0
+        self.bytes_written = 0
+
+    def write(self, data: bytes) -> None:
+        framed = encode_record(data)
+        self._fh.write(framed)
+        self.records_written += 1
+        self.bytes_written += len(framed)
+
+    def flush(self) -> None:
+        self._fh.flush()
+
+
+class RecordReader:
+    """Streaming TFRecord reader over a binary file object.
+
+    TPU-native counterpart of the shaded ``TFRecordFileInputFormat`` record
+    reader used at reference TFRecordFileReader.scala:32-51.
+    """
+
+    def __init__(self, fh: BinaryIO, verify_crc: bool = True):
+        self._fh = fh
+        self._verify = verify_crc
+        self.records_read = 0
+        self.bytes_read = 0
+
+    def read(self) -> Optional[bytes]:
+        """Read one record; returns None at a clean EOF."""
+        header = self._fh.read(HEADER_BYTES)
+        if len(header) == 0:
+            return None
+        if len(header) < HEADER_BYTES:
+            raise TFRecordCorruptionError("truncated TFRecord header")
+        (length,) = _LEN_STRUCT.unpack_from(header, 0)
+        (length_crc,) = _CRC_STRUCT.unpack_from(header, 8)
+        if self._verify and masked_crc32c(header[:8]) != length_crc:
+            raise TFRecordCorruptionError("corrupt TFRecord: bad length CRC")
+        body = self._fh.read(length + FOOTER_BYTES)
+        if len(body) < length + FOOTER_BYTES:
+            raise TFRecordCorruptionError("truncated TFRecord body")
+        data = body[:length]
+        if self._verify:
+            (data_crc,) = _CRC_STRUCT.unpack_from(body, length)
+            if masked_crc32c(data) != data_crc:
+                raise TFRecordCorruptionError("corrupt TFRecord: bad data CRC")
+        self.records_read += 1
+        self.bytes_read += HEADER_BYTES + length + FOOTER_BYTES
+        return data
+
+    def __iter__(self) -> Iterator[bytes]:
+        while True:
+            rec = self.read()
+            if rec is None:
+                return
+            yield rec
+
+
+def scan_buffer(
+    buf: bytes, verify_crc: bool = True
+) -> Iterator[Tuple[int, int]]:
+    """Yield (offset, length) of each record payload in an in-memory buffer.
+
+    This is the zero-copy scan used by the columnar fast path: the C++
+    extension implements the same contract over an mmap'd shard.
+    """
+    pos = 0
+    n = len(buf)
+    view = memoryview(buf)
+    while pos < n:
+        if pos + HEADER_BYTES > n:
+            raise TFRecordCorruptionError("truncated TFRecord header")
+        (length,) = _LEN_STRUCT.unpack_from(buf, pos)
+        if verify_crc:
+            (length_crc,) = _CRC_STRUCT.unpack_from(buf, pos + 8)
+            if masked_crc32c(bytes(view[pos : pos + 8])) != length_crc:
+                raise TFRecordCorruptionError("corrupt TFRecord: bad length CRC")
+        start = pos + HEADER_BYTES
+        end = start + length
+        if end + FOOTER_BYTES > n:
+            raise TFRecordCorruptionError("truncated TFRecord body")
+        if verify_crc:
+            (data_crc,) = _CRC_STRUCT.unpack_from(buf, end)
+            if masked_crc32c(bytes(view[start:end])) != data_crc:
+                raise TFRecordCorruptionError("corrupt TFRecord: bad data CRC")
+        yield start, length
+        pos = end + FOOTER_BYTES
+
+
+# ---------------------------------------------------------------------------
+# File-level helpers
+# ---------------------------------------------------------------------------
+
+
+def write_records(
+    path: str, records, codec: Optional[str] = None
+) -> int:
+    """Write an iterable of serialized records to one TFRecord file."""
+    count = 0
+    with open_compressed(path, "wb", codec) as fh:
+        writer = RecordWriter(fh)
+        for rec in records:
+            writer.write(rec)
+            count += 1
+    return count
+
+
+def read_records(
+    path: str, codec: Optional[str] = "auto", verify_crc: bool = True
+) -> Iterator[bytes]:
+    """Iterate serialized records from one TFRecord file.
+
+    ``codec='auto'`` infers compression from the extension the way the
+    reference's read path relies on Hadoop to (README.md: codec "can be
+    inferred automatically" on read).
+    """
+    if codec == "auto":
+        codec = codec_from_path(path)
+    with open_compressed(path, "rb", codec) as fh:
+        yield from RecordReader(fh, verify_crc=verify_crc)
+
+
+def file_is_empty(path: str) -> bool:
+    """True if the file has zero length (ref DefaultSource.scala:82-87)."""
+    return os.path.getsize(path) == 0
