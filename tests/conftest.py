@@ -9,13 +9,20 @@ the reference's in-process Spark local mode (SharedSparkSessionSuite.scala).
 import os
 import sys
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before the CPU backend is CREATED (not merely before jax is
+# imported — the environment's sitecustomize may import jax at interpreter
+# start, e.g. to register a TPU plugin). Backends initialize lazily, so
+# forcing the platform through jax.config still works here.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

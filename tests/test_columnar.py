@@ -1,0 +1,199 @@
+"""Tests for the columnar batch decoder (the TPU hot path) — checked against
+the row-oriented serde as its correctness oracle."""
+
+import numpy as np
+import pytest
+
+from tpu_tfrecord.columnar import (
+    ColumnarDecoder,
+    bucket_boundaries,
+    pad_ragged,
+    pad_ragged2,
+)
+from tpu_tfrecord.options import RecordType
+from tpu_tfrecord.proto import Example, Feature, FeatureList, SequenceExample, encode_example, encode_sequence_example
+from tpu_tfrecord.schema import (
+    ArrayType,
+    BinaryType,
+    DoubleType,
+    FloatType,
+    IntegerType,
+    LongType,
+    StringType,
+    StructField,
+    StructType,
+)
+from tpu_tfrecord.serde import NullValueError, TFRecordSerializer, encode_row
+
+
+class TestExampleDecoding:
+    SCHEMA = StructType(
+        [
+            StructField("i", IntegerType()),
+            StructField("l", LongType()),
+            StructField("f", FloatType()),
+            StructField("d", DoubleType()),
+            StructField("s", StringType()),
+            StructField("fv", ArrayType(FloatType())),
+            StructField("lv", ArrayType(LongType())),
+        ]
+    )
+
+    ROWS = [
+        [1, 10, 0.5, 1.5, "a", [1.0, 2.0], [7]],
+        [2, 20, 1.5, 2.5, "b", [3.0], [8, 9, 10]],
+        [3, 30, 2.5, 3.5, "c", [], [11, 12]],
+    ]
+
+    def _records(self):
+        ser = TFRecordSerializer(self.SCHEMA)
+        return [encode_row(ser, RecordType.EXAMPLE, r) for r in self.ROWS]
+
+    def test_scalar_columns(self):
+        batch = ColumnarDecoder(self.SCHEMA).decode_batch(self._records())
+        assert batch.num_rows == 3
+        np.testing.assert_array_equal(batch["i"].values, np.array([1, 2, 3], np.int32))
+        assert batch["i"].values.dtype == np.int32
+        np.testing.assert_array_equal(batch["l"].values, [10, 20, 30])
+        assert batch["l"].values.dtype == np.int64
+        np.testing.assert_allclose(batch["f"].values, [0.5, 1.5, 2.5])
+        assert batch["f"].values.dtype == np.float32
+        # double comes off the wire as f32, widened to f64 column
+        assert batch["d"].values.dtype == np.float64
+        np.testing.assert_allclose(batch["d"].values, [1.5, 2.5, 3.5])
+        assert batch["s"].blobs == [b"a", b"b", b"c"]
+
+    def test_ragged_columns(self):
+        batch = ColumnarDecoder(self.SCHEMA).decode_batch(self._records())
+        fv = batch["fv"]
+        np.testing.assert_array_equal(fv.offsets, [0, 2, 3, 3])
+        np.testing.assert_allclose(fv.values, [1.0, 2.0, 3.0])
+        lv = batch["lv"]
+        np.testing.assert_array_equal(lv.offsets, [0, 1, 4, 6])
+        np.testing.assert_array_equal(lv.values, [7, 8, 9, 10, 11, 12])
+
+    def test_missing_nullable_masks(self):
+        schema = StructType([StructField("x", LongType()), StructField("y", FloatType())])
+        recs = [
+            encode_example(Example(features={"x": Feature.int64_list([1])})),
+            encode_example(
+                Example(features={"x": Feature.int64_list([2]), "y": Feature.float_list([5.0])})
+            ),
+        ]
+        batch = ColumnarDecoder(schema).decode_batch(recs)
+        np.testing.assert_array_equal(batch["y"].mask, [False, True])
+        np.testing.assert_allclose(batch["y"].values, [0.0, 5.0])
+
+    def test_missing_non_nullable_raises(self):
+        schema = StructType([StructField("x", LongType(), nullable=False)])
+        recs = [encode_example(Example())]
+        with pytest.raises(NullValueError):
+            ColumnarDecoder(schema).decode_batch(recs)
+
+    def test_kind_mismatch_raises(self):
+        schema = StructType([StructField("x", FloatType())])
+        recs = [encode_example(Example(features={"x": Feature.int64_list([1])}))]
+        with pytest.raises(ValueError, match="does not match"):
+            ColumnarDecoder(schema).decode_batch(recs)
+
+    def test_extra_features_skipped(self):
+        schema = StructType([StructField("x", LongType())])
+        recs = [
+            encode_example(
+                Example(
+                    features={
+                        "x": Feature.int64_list([1]),
+                        "junk": Feature.bytes_list([b"ignored"]),
+                    }
+                )
+            )
+        ]
+        batch = ColumnarDecoder(schema).decode_batch(recs)
+        np.testing.assert_array_equal(batch["x"].values, [1])
+
+    def test_byte_array_passthrough(self):
+        schema = StructType([StructField("byteArray", BinaryType())])
+        batch = ColumnarDecoder(schema, RecordType.BYTE_ARRAY).decode_batch([b"a", b"bb"])
+        assert batch["byteArray"].blobs == [b"a", b"bb"]
+
+
+class TestSequenceExampleDecoding:
+    SCHEMA = StructType(
+        [
+            StructField("id", LongType()),
+            StructField("frames", ArrayType(ArrayType(FloatType()))),
+        ]
+    )
+
+    def test_ragged2(self):
+        ses = [
+            SequenceExample(
+                context={"id": Feature.int64_list([1])},
+                feature_lists={
+                    "frames": FeatureList(
+                        [Feature.float_list([1.0, 2.0]), Feature.float_list([3.0])]
+                    )
+                },
+            ),
+            SequenceExample(
+                context={"id": Feature.int64_list([2])},
+                feature_lists={"frames": FeatureList([Feature.float_list([4.0, 5.0, 6.0])])},
+            ),
+        ]
+        recs = [encode_sequence_example(se) for se in ses]
+        batch = ColumnarDecoder(self.SCHEMA, RecordType.SEQUENCE_EXAMPLE).decode_batch(recs)
+        fr = batch["frames"]
+        np.testing.assert_array_equal(batch["id"].values, [1, 2])
+        np.testing.assert_array_equal(fr.offsets, [0, 2, 3])  # rows -> inner lists
+        np.testing.assert_array_equal(fr.inner_offsets, [0, 2, 3, 6])
+        np.testing.assert_allclose(fr.values, [1.0, 2.0, 3.0, 4.0, 5.0, 6.0])
+
+    def test_featurelist_of_scalars_as_ragged(self):
+        schema = StructType([StructField("toks", ArrayType(LongType()))])
+        se = SequenceExample(
+            feature_lists={
+                "toks": FeatureList([Feature.int64_list([5]), Feature.int64_list([6])])
+            }
+        )
+        batch = ColumnarDecoder(schema, RecordType.SEQUENCE_EXAMPLE).decode_batch(
+            [encode_sequence_example(se)]
+        )
+        np.testing.assert_array_equal(batch["toks"].offsets, [0, 2])
+        np.testing.assert_array_equal(batch["toks"].values, [5, 6])
+
+
+class TestPadding:
+    def test_pad_ragged(self):
+        values = np.array([1, 2, 3, 4, 5, 6], dtype=np.int64)
+        offsets = np.array([0, 2, 2, 6])
+        dense, lengths = pad_ragged(values, offsets, max_len=3, pad_value=-1)
+        np.testing.assert_array_equal(
+            dense, [[1, 2, -1], [-1, -1, -1], [3, 4, 5]]
+        )
+        np.testing.assert_array_equal(lengths, [2, 0, 3])  # truncated row 2
+
+    def test_pad_ragged_auto_max(self):
+        dense, lengths = pad_ragged(np.array([1.0, 2.0]), np.array([0, 1, 2]))
+        assert dense.shape == (2, 1)
+
+    def test_pad_ragged_empty(self):
+        dense, lengths = pad_ragged(np.array([], dtype=np.float32), np.array([0]))
+        assert dense.shape == (0, 0)
+
+    def test_pad_ragged2(self):
+        # 2 rows: [[1,2],[3]] and [[4,5,6]]
+        values = np.array([1, 2, 3, 4, 5, 6], dtype=np.float32)
+        inner = np.array([0, 2, 3, 6])
+        splits = np.array([0, 2, 3])
+        dense, outer_len, inner_len = pad_ragged2(values, inner, splits, 2, 3)
+        assert dense.shape == (2, 2, 3)
+        np.testing.assert_allclose(dense[0, 0], [1, 2, 0])
+        np.testing.assert_allclose(dense[0, 1], [3, 0, 0])
+        np.testing.assert_allclose(dense[1, 0], [4, 5, 6])
+        np.testing.assert_array_equal(outer_len, [2, 1])
+        np.testing.assert_array_equal(inner_len, [[2, 1], [3, 0]])
+
+    def test_bucket_boundaries(self):
+        bounds = bucket_boundaries([1, 2, 3, 4, 100], num_buckets=2)
+        assert bounds[-1] == 100
+        assert len(bounds) >= 1

@@ -1,0 +1,117 @@
+"""Tests for the streaming dataset pipeline: batching across shards,
+prefetch, per-host shard assignment, checkpoint/resume."""
+
+import numpy as np
+import pytest
+
+import tpu_tfrecord.io as tfio
+from tpu_tfrecord.io.dataset import IteratorState, TFRecordDataset
+from tpu_tfrecord.schema import FloatType, LongType, StringType, StructField, StructType
+
+SCHEMA = StructType(
+    [
+        StructField("uid", LongType()),
+        StructField("score", FloatType()),
+        StructField("tag", StringType()),
+    ]
+)
+
+
+def write_shards(sandbox, num_shards=4, rows_per_shard=10):
+    out = str(sandbox / "ds")
+    rows = []
+    uid = 0
+    for s in range(num_shards):
+        shard_rows = [[uid + i, float(uid + i) / 2, f"t{s}"] for i in range(rows_per_shard)]
+        uid += rows_per_shard
+        rows.append(shard_rows)
+    # one write per shard => num_shards files (append accumulates)
+    for shard_rows in rows:
+        tfio.write(shard_rows, SCHEMA, out, mode="append")
+    return out
+
+
+class TestBatching:
+    def test_batches_span_shards(self, sandbox):
+        out = write_shards(sandbox, num_shards=4, rows_per_shard=10)
+        ds = TFRecordDataset(out, batch_size=16, schema=SCHEMA)
+        with ds.batches() as it:
+            batches = list(it)
+        assert [b.num_rows for b in batches] == [16, 16]  # 40 rows, drop rem 8
+        all_uids = np.concatenate([b["uid"].values for b in batches])
+        assert len(set(all_uids.tolist())) == 32
+
+    def test_keep_remainder(self, sandbox):
+        out = write_shards(sandbox, num_shards=2, rows_per_shard=5)
+        ds = TFRecordDataset(out, batch_size=4, schema=SCHEMA, drop_remainder=False)
+        with ds.batches() as it:
+            sizes = [b.num_rows for b in it]
+        assert sizes == [4, 4, 2]
+
+    def test_multiple_epochs(self, sandbox):
+        out = write_shards(sandbox, num_shards=2, rows_per_shard=4)
+        ds = TFRecordDataset(out, batch_size=4, schema=SCHEMA, num_epochs=3)
+        with ds.batches() as it:
+            total = sum(b.num_rows for b in it)
+        assert total == 24
+
+    def test_column_pruning(self, sandbox):
+        out = write_shards(sandbox, num_shards=1, rows_per_shard=4)
+        ds = TFRecordDataset(out, batch_size=4, schema=SCHEMA, columns=["score"])
+        with ds.batches() as it:
+            b = next(it)
+        assert set(b.columns) == {"score"}
+
+
+class TestShardAssignment:
+    def test_processes_partition_the_data(self, sandbox):
+        out = write_shards(sandbox, num_shards=4, rows_per_shard=4)
+        seen = []
+        for pi in range(2):
+            ds = TFRecordDataset(
+                out, batch_size=4, schema=SCHEMA, process_index=pi, process_count=2
+            )
+            assert len(ds.shards) == 2
+            with ds.batches() as it:
+                for b in it:
+                    seen.extend(b["uid"].values.tolist())
+        assert sorted(seen) == list(range(16))
+
+
+class TestCheckpointResume:
+    def test_resume_continues_exactly(self, sandbox):
+        out = write_shards(sandbox, num_shards=3, rows_per_shard=5)
+        # the dataset's own deterministic order is the ground truth
+        ref = TFRecordDataset(out, batch_size=4, schema=SCHEMA)
+        expected = []
+        with ref.batches() as it:
+            for b in it:
+                expected.extend(b["uid"].values.tolist())
+        assert len(expected) == 12  # 15 rows, 12 in full batches
+
+        ds = TFRecordDataset(out, batch_size=4, schema=SCHEMA)
+        with ds.batches() as it:
+            b1 = next(it)
+            first_uids = b1["uid"].values.tolist()
+            state = it.state()
+        # resume from the saved state: must produce the NEXT batch, no overlap
+        ds2 = TFRecordDataset(out, batch_size=4, schema=SCHEMA)
+        resumed_uids = []
+        with ds2.batches(state) as it2:
+            for b in it2:
+                resumed_uids.extend(b["uid"].values.tolist())
+        assert first_uids == expected[:4]
+        assert resumed_uids == expected[4:]
+
+    def test_state_round_trips_json(self):
+        s = IteratorState(epoch=1, shard_cursor=2, record_offset=3)
+        assert IteratorState.from_json(s.to_json()) == s
+
+    def test_fresh_state_is_zero(self, sandbox):
+        out = write_shards(sandbox, num_shards=1, rows_per_shard=2)
+        ds = TFRecordDataset(out, batch_size=2, schema=SCHEMA)
+        with ds.batches() as it:
+            assert it.state() == IteratorState()
+            next(it)
+            st = it.state()
+        assert st.record_offset == 2

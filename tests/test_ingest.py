@@ -1,0 +1,201 @@
+"""Tier-2 tests for the TPU ingest slice on the 8-device CPU mesh:
+dataset -> columnar batches -> dense host batches -> sharded jax.Array.
+
+The "minimum end-to-end slice" of SURVEY.md §7.6: README-style schema,
+round-trip into a sharded array on Mesh(('data',)), verified by value.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import tpu_tfrecord.io as tfio
+from tpu_tfrecord.columnar import ColumnarDecoder
+from tpu_tfrecord.io.dataset import TFRecordDataset
+from tpu_tfrecord.options import RecordType
+from tpu_tfrecord.schema import (
+    ArrayType,
+    FloatType,
+    LongType,
+    StringType,
+    StructField,
+    StructType,
+)
+from tpu_tfrecord.serde import TFRecordSerializer, encode_row
+from tpu_tfrecord.tpu import (
+    assign_shards,
+    batch_spec,
+    create_mesh,
+    data_sharding,
+    DeviceIterator,
+    hash_bytes_column,
+    host_batch_from_columnar,
+    make_global_batch,
+)
+
+SCHEMA = StructType(
+    [
+        StructField("uid", LongType()),
+        StructField("score", FloatType()),
+        StructField("emb", ArrayType(FloatType())),
+        StructField("cat", StringType()),
+    ]
+)
+
+
+def write_dataset(sandbox, n=32):
+    out = str(sandbox / "ingest")
+    rows = [[i, i / 2.0, [float(i), float(i + 1), float(i + 2)], f"cat{i % 4}"] for i in range(n)]
+    tfio.write(rows, SCHEMA, out, mode="overwrite")
+    return out
+
+
+class TestMesh:
+    def test_create_default_mesh(self):
+        mesh = create_mesh()
+        assert mesh.shape["data"] == 8
+
+    def test_create_2d_mesh(self):
+        mesh = create_mesh({"data": -1, "model": 2})
+        assert mesh.shape["data"] == 4 and mesh.shape["model"] == 2
+
+    def test_bad_mesh_rejected(self):
+        with pytest.raises(ValueError):
+            create_mesh({"data": 3})
+        with pytest.raises(ValueError):
+            create_mesh({"a": -1, "b": -1})
+
+    def test_assign_shards_deterministic_interleave(self, sandbox):
+        out = write_dataset(sandbox)
+        shards = tfio.discover_shards(out)
+        a = assign_shards(shards, process_index=0, process_count=2)
+        b = assign_shards(shards, process_index=1, process_count=2)
+        assert {s.path for s in a} | {s.path for s in b} == {s.path for s in shards}
+        assert not ({s.path for s in a} & {s.path for s in b})
+
+
+class TestHostBatch:
+    def test_dense_host_batch(self, sandbox):
+        out = write_dataset(sandbox, n=8)
+        ds = TFRecordDataset(out, batch_size=8, schema=SCHEMA)
+        with ds.batches() as it:
+            cb = next(it)
+        hb = host_batch_from_columnar(
+            cb, ds.schema, pad_to={"emb": 4}, hash_buckets={"cat": 16}
+        )
+        assert hb["uid"].shape == (8,)
+        assert hb["emb"].shape == (8, 4)
+        np.testing.assert_allclose(hb["emb"][0], [0.0, 1.0, 2.0, 0.0])
+        np.testing.assert_array_equal(hb["emb_len"], [3] * 8)
+        assert hb["cat"].dtype == np.int64
+        assert (hb["cat"] < 16).all() and (hb["cat"] >= 0).all()
+
+    def test_hashing_is_deterministic(self):
+        a = hash_bytes_column([b"x", b"y", b"x"], 1000)
+        b = hash_bytes_column([b"x", b"y", b"x"], 1000)
+        np.testing.assert_array_equal(a, b)
+        assert a[0] == a[2]
+
+    def test_batch_spec_matches_host_batch(self, sandbox):
+        out = write_dataset(sandbox, n=8)
+        ds = TFRecordDataset(out, batch_size=8, schema=SCHEMA)
+        spec = batch_spec(ds.schema, 8, pad_to={"emb": 4}, hash_buckets={"cat": 16})
+        with ds.batches() as it:
+            hb = host_batch_from_columnar(
+                next(it), ds.schema, pad_to={"emb": 4}, hash_buckets={"cat": 16}
+            )
+        assert set(spec) == set(hb)
+        for name, s in spec.items():
+            assert hb[name].shape == s.shape, name
+            assert hb[name].dtype == s.dtype, name
+
+
+class TestShardedIngest:
+    def test_global_batch_sharded_on_data_axis(self, sandbox):
+        out = write_dataset(sandbox, n=16)
+        mesh = create_mesh()
+        ds = TFRecordDataset(out, batch_size=16, schema=SCHEMA)
+        with ds.batches() as it:
+            hb = host_batch_from_columnar(
+                next(it), ds.schema, pad_to={"emb": 4}, hash_buckets={"cat": 8}
+            )
+        gb = make_global_batch(hb, mesh)
+        arr = gb["uid"]
+        assert isinstance(arr, jax.Array)
+        assert arr.shape == (16,)
+        assert arr.sharding.spec == P("data")
+        # every device holds 2 rows
+        assert {s.data.shape for s in arr.addressable_shards} == {(2,)}
+        np.testing.assert_array_equal(np.asarray(arr), np.asarray(hb["uid"]))
+        assert gb["emb"].shape == (16, 4)
+        assert gb["emb"].sharding.spec == P("data", None)
+
+    def test_jit_consumes_sharded_batch(self, sandbox):
+        """The aha slice: decoded records feed a jit computation over the mesh
+        and come back correctly reduced."""
+        out = write_dataset(sandbox, n=16)
+        mesh = create_mesh()
+        ds = TFRecordDataset(out, batch_size=16, schema=SCHEMA)
+        with ds.batches() as it:
+            hb = host_batch_from_columnar(next(it), ds.schema, pad_to={"emb": 3})
+        gb = make_global_batch(hb, mesh)
+
+        @jax.jit
+        def step(emb, score):
+            return (emb.sum(axis=1) * score).sum()
+
+        got = step(gb["emb"], gb["score"])
+        want = (hb["emb"].sum(axis=1) * hb["score"]).sum()
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-6)
+
+    def test_device_iterator_double_buffers(self, sandbox):
+        out = write_dataset(sandbox, n=32)
+        mesh = create_mesh()
+        ds = TFRecordDataset(out, batch_size=8, schema=SCHEMA)
+
+        def host_batches():
+            with ds.batches() as it:
+                for cb in it:
+                    yield host_batch_from_columnar(cb, ds.schema, pad_to={"emb": 3})
+
+        count = 0
+        seen_uids = []
+        for gb in DeviceIterator(host_batches(), mesh):
+            assert gb["uid"].sharding.spec == P("data")
+            seen_uids.extend(np.asarray(gb["uid"]).tolist())
+            count += 1
+        assert count == 4
+        assert sorted(seen_uids) == list(range(32))
+
+
+class TestSequenceIngest:
+    def test_ragged2_to_dense_device_array(self, sandbox):
+        schema = StructType(
+            [
+                StructField("id", LongType()),
+                StructField("frames", ArrayType(ArrayType(FloatType()))),
+            ]
+        )
+        rows = [
+            [0, [[1.0, 2.0], [3.0]]],
+            [1, [[4.0, 5.0, 6.0]]],
+            [2, [[7.0]]],
+            [3, [[8.0], [9.0], [10.0]]],
+        ] * 2
+        out = str(sandbox / "seq")
+        tfio.write(rows, schema, out, mode="overwrite", recordType="SequenceExample")
+        mesh = create_mesh()
+        ds = TFRecordDataset(
+            out, batch_size=8, schema=schema, recordType="SequenceExample"
+        )
+        with ds.batches() as it:
+            cb = next(it)
+        hb = host_batch_from_columnar(cb, ds.schema, pad_to={"frames": (4, 4)})
+        assert hb["frames"].shape == (8, 4, 4)
+        gb = make_global_batch(hb, mesh)
+        assert gb["frames"].shape == (8, 4, 4)
+        assert gb["frames_len"].shape == (8,)
+        row0 = np.asarray(gb["frames"])[0]
+        np.testing.assert_allclose(row0[0, :2], [1.0, 2.0])
+        np.testing.assert_allclose(row0[1, 0], 3.0)

@@ -1,0 +1,103 @@
+"""Tests for the flagship DLRM consumer + the driver entry points on the
+8-device CPU mesh (dp x tp x sp shardings compile and execute)."""
+
+import functools
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from tpu_tfrecord.models import (
+    DLRMConfig,
+    forward,
+    init_params,
+    loss_fn,
+    make_synthetic_batch,
+    param_shardings,
+    train_step,
+)
+from tpu_tfrecord.models.dlrm import batch_shardings
+from tpu_tfrecord.tpu.mesh import create_mesh
+
+
+class TestDLRM:
+    def test_forward_shapes_and_dtype(self):
+        cfg = DLRMConfig(num_dense=4, num_categorical=3, vocab_size=16, embed_dim=4,
+                         bottom_mlp=(8, 4), top_mlp=(8, 1))
+        params = init_params(jax.random.key(0), cfg)
+        batch = {k: jax.numpy.asarray(v) for k, v in make_synthetic_batch(cfg, 8).items()}
+        logits = jax.jit(functools.partial(forward, cfg=cfg))(params, batch)
+        assert logits.shape == (8,)
+        assert logits.dtype == jax.numpy.float32
+
+    def test_loss_decreases_under_training(self):
+        cfg = DLRMConfig(num_dense=4, num_categorical=3, vocab_size=16, embed_dim=4,
+                         bottom_mlp=(8, 4), top_mlp=(8, 1))
+        params = init_params(jax.random.key(1), cfg)
+        batch = {k: jax.numpy.asarray(v) for k, v in make_synthetic_batch(cfg, 32).items()}
+        tx = optax.adam(1e-2)
+        opt_state = tx.init(params)
+        step = jax.jit(functools.partial(train_step, cfg=cfg, tx=tx))
+        first = float(loss_fn(params, batch, cfg))
+        for _ in range(20):
+            params, opt_state, loss = step(params, opt_state, batch)
+        assert float(loss) < first
+
+    def test_sequence_tower(self):
+        cfg = DLRMConfig(num_dense=2, num_categorical=2, vocab_size=8, embed_dim=4,
+                         bottom_mlp=(4,), top_mlp=(4, 1), seq_len=6, seq_dim=3)
+        params = init_params(jax.random.key(2), cfg)
+        batch = {k: jax.numpy.asarray(v) for k, v in make_synthetic_batch(cfg, 4).items()}
+        logits = forward(params, batch, cfg)
+        assert logits.shape == (4,)
+        # padding must not influence the pooled sequence features
+        b2 = dict(batch)
+        frames = np.asarray(batch["frames"]).copy()
+        lens = np.asarray(batch["frames_len"])
+        for i, l in enumerate(lens):
+            frames[i, l:] = 999.0  # garbage in padded region
+        b2["frames"] = jax.numpy.asarray(frames)
+        logits2 = forward(params, b2, cfg)
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(logits2), rtol=2e-2)
+
+
+class TestGraftEntry:
+    def test_entry_compiles_and_runs(self):
+        import __graft_entry__ as ge
+
+        fn, args = ge.entry()
+        out = jax.jit(fn)(*args)
+        assert out.shape == (32,)
+        assert np.isfinite(np.asarray(out)).all()
+
+    @pytest.mark.parametrize("n", [8, 4, 2, 1])
+    def test_dryrun_multichip(self, n):
+        import __graft_entry__ as ge
+
+        ge.dryrun_multichip(n)
+
+
+class TestShardedTrainStep:
+    def test_tp_matches_replicated(self):
+        """The tensor-parallel layout must compute the same loss as fully
+        replicated params (collectives are inserted, not semantics changed)."""
+        cfg = DLRMConfig(num_dense=4, num_categorical=3, vocab_size=16, embed_dim=4,
+                         bottom_mlp=(8, 4), top_mlp=(8, 1))
+        params = init_params(jax.random.key(3), cfg)
+        host = make_synthetic_batch(cfg, 16, seed=7)
+
+        # replicated single-device loss
+        batch1 = {k: jax.numpy.asarray(v) for k, v in host.items()}
+        want = float(loss_fn(params, batch1, cfg))
+
+        mesh = create_mesh({"data": 4, "model": 2})
+        p_shard = param_shardings(mesh, params)
+        sharded_params = jax.device_put(params, p_shard)
+        b_shard = batch_shardings(mesh, host)
+        batch = {
+            k: jax.make_array_from_process_local_data(b_shard[k], v)
+            for k, v in host.items()
+        }
+        got = float(jax.jit(functools.partial(loss_fn, cfg=cfg))(sharded_params, batch))
+        assert got == pytest.approx(want, rel=2e-2)  # bf16 tolerance

@@ -1,0 +1,146 @@
+"""Regression tests for review findings: job-temp isolation, rollover
+atomicity, partition-column materialization, iterator termination, strict
+padding, local batch sizing."""
+
+import os
+
+import numpy as np
+import pytest
+
+import tpu_tfrecord.io as tfio
+from tpu_tfrecord.io.dataset import TFRecordDataset
+from tpu_tfrecord.io.writer import DatasetWriter
+from tpu_tfrecord.options import TFRecordOptions
+from tpu_tfrecord.schema import (
+    ArrayType,
+    FloatType,
+    LongType,
+    StringType,
+    StructField,
+    StructType,
+)
+from tpu_tfrecord.tpu import create_mesh, host_batch_from_columnar
+from tpu_tfrecord.tpu.mesh import local_batch_size
+
+SCHEMA = StructType([StructField("uid", LongType()), StructField("tag", StringType())])
+
+
+class TestWriterAtomicity:
+    def test_failed_job_leaves_no_final_files(self, sandbox):
+        """Rollover shards must NOT appear in the output dir if the job fails."""
+        out = str(sandbox / "fail")
+
+        def rows():
+            for i in range(25):
+                yield [i, "t"]
+            raise RuntimeError("mid-job failure")
+
+        w = DatasetWriter(out, SCHEMA, TFRecordOptions(), mode="overwrite",
+                          max_records_per_file=10)
+        with pytest.raises(RuntimeError, match="mid-job"):
+            w.write_rows(rows())
+        data_files = [
+            f for f in os.listdir(out) if not f.startswith("_")
+        ] if os.path.isdir(out) else []
+        assert data_files == []
+        assert not tfio.has_success_marker(out)
+
+    def test_rollover_commits_all_at_end(self, sandbox):
+        out = str(sandbox / "roll")
+        w = DatasetWriter(out, SCHEMA, TFRecordOptions(), mode="overwrite",
+                          max_records_per_file=10)
+        files = w.write_rows([[i, "t"] for i in range(25)])
+        assert len(files) == 3
+        assert len(tfio.read(out, schema=SCHEMA)) == 25
+
+    def test_other_jobs_temp_dir_survives(self, sandbox):
+        """Completing one job must not clobber another job's in-flight temp."""
+        out = str(sandbox / "concurrent")
+        os.makedirs(os.path.join(out, "_temporary", "other-job"))
+        open(os.path.join(out, "_temporary", "other-job", "in-flight.tmp"), "wb").close()
+        w = DatasetWriter(out, SCHEMA, TFRecordOptions(), mode="append")
+        w.write_rows([[1, "a"]])
+        assert os.path.exists(
+            os.path.join(out, "_temporary", "other-job", "in-flight.tmp")
+        )
+
+
+class TestPartitionColumnsInBatches:
+    def test_requested_partition_column_materialized(self, sandbox):
+        out = str(sandbox / "pds")
+        rows = [[i, "a" if i < 4 else "b"] for i in range(8)]
+        schema = StructType([StructField("uid", LongType()), StructField("day", StringType())])
+        tfio.write(rows, schema, out, mode="overwrite", partition_by=["day"])
+        ds = TFRecordDataset(out, batch_size=8, drop_remainder=False,
+                             columns=["uid", "day"])
+        with ds.batches() as it:
+            b = next(it)
+        assert "day" in b.columns
+        uid = b["uid"].values
+        day = [blob.decode() for blob in b["day"].blobs]
+        for u, d in zip(uid.tolist(), day):
+            assert d == ("a" if u < 4 else "b")
+
+    def test_numeric_partition_column(self, sandbox):
+        out = str(sandbox / "npds")
+        schema = StructType([StructField("v", FloatType()), StructField("shard", LongType())])
+        tfio.write([[0.5, 3], [1.5, 7]], schema, out, mode="overwrite",
+                   partition_by=["shard"])
+        ds = TFRecordDataset(out, batch_size=2, drop_remainder=False)
+        with ds.batches() as it:
+            b = next(it)
+        assert set(b.columns) == {"v", "shard"}
+        assert b["shard"].values.dtype == np.int64
+        assert sorted(b["shard"].values.tolist()) == [3, 7]
+
+
+class TestIteratorTermination:
+    def test_next_after_exhaustion_raises_stopiteration(self, sandbox):
+        out = str(sandbox / "term")
+        tfio.write([[i, "t"] for i in range(4)], SCHEMA, out, mode="overwrite")
+        ds = TFRecordDataset(out, batch_size=2, schema=SCHEMA)
+        it = ds.batches()
+        list(it)
+        with pytest.raises(StopIteration):
+            next(it)
+        with pytest.raises(StopIteration):
+            next(it)
+        it.close()
+
+    def test_producer_error_re_raised_every_time(self, sandbox):
+        out = str(sandbox / "err")
+        tfio.write([[1, "t"]], SCHEMA, out, mode="overwrite")
+        # corrupt the shard
+        f = [p for p in os.listdir(out) if p.endswith(".tfrecord")][0]
+        path = os.path.join(out, f)
+        raw = bytearray(open(path, "rb").read())
+        raw[14] ^= 0xFF
+        open(path, "wb").write(bytes(raw))
+        ds = TFRecordDataset(out, batch_size=1, schema=SCHEMA)
+        it = ds.batches()
+        with pytest.raises(Exception):
+            next(it)
+        with pytest.raises(Exception):
+            next(it)  # must not hang
+        it.close()
+
+
+class TestStrictPadding:
+    def test_missing_pad_to_raises(self, sandbox):
+        schema = StructType([StructField("emb", ArrayType(FloatType()))])
+        out = str(sandbox / "pad")
+        tfio.write([[[1.0, 2.0]]], schema, out, mode="overwrite")
+        ds = TFRecordDataset(out, batch_size=1, schema=schema, drop_remainder=False)
+        with ds.batches() as it:
+            cb = next(it)
+        with pytest.raises(ValueError, match="pad_to"):
+            host_batch_from_columnar(cb, ds.schema)
+
+
+class TestLocalBatchSize:
+    def test_rejects_non_divisible_process_count(self):
+        mesh = create_mesh({"data": 2, "model": 4})
+        # single process: divisible by axis and by process count (1)
+        assert local_batch_size(2, mesh) == 2
+        with pytest.raises(ValueError):
+            local_batch_size(3, mesh)

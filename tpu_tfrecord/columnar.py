@@ -1,0 +1,368 @@
+"""Columnar batch decoding: serialized records -> numpy column buffers.
+
+This is the TPU-native hot path. The reference materializes one
+SpecificInternalRow per record (TFRecordFileReader.scala:46-82) because Spark
+is a row engine; a TPU wants large dense device arrays, so here a batch of
+serialized tf.Example records decodes STRAIGHT into per-column numpy buffers
+— no per-record row objects, no per-field boxing:
+
+- numeric scalar column  -> values[N] + validity mask[N]
+- numeric array column   -> ragged: values[total] + offsets[N+1]
+- array-of-array column  -> ragged^2: values[total] + inner_offsets[M+1]
+                            + row_splits[N+1] (SequenceExample FeatureLists)
+- string/binary columns  -> list of bytes (vocab/hashing happens host-side)
+
+The same layout is produced by the C++ extension (tpu_tfrecord._native) at
+>10x the throughput; this module is the pure-Python reference implementation
+and the correctness oracle for it.
+
+Ragged columns pad/bucket into dense [batch, max_len] arrays in
+tpu_tfrecord.tpu.ingest — the "first-class ragged-sequence decode" plan of
+SURVEY.md §5 (long-context story).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from tpu_tfrecord import proto
+from tpu_tfrecord.options import RecordType
+from tpu_tfrecord.schema import (
+    ArrayType,
+    BinaryType,
+    DataType,
+    DecimalType,
+    DoubleType,
+    FloatType,
+    IntegerType,
+    LongType,
+    NullType,
+    StringType,
+    StructType,
+    numpy_dtype,
+)
+from tpu_tfrecord.serde import NullValueError
+
+
+@dataclass
+class Column:
+    """One decoded column. Exactly one of the layouts below is populated.
+
+    - scalar numeric: ``values`` [N]
+    - ragged numeric: ``values`` [total] + ``offsets`` [N+1]
+    - ragged^2 numeric: ``values`` [total] + ``inner_offsets`` + ``offsets``
+      (offsets indexes into inner_offsets: row i spans inner lists
+      offsets[i]:offsets[i+1], inner list j spans values
+      inner_offsets[j]:inner_offsets[j+1])
+    - bytes-like: ``blobs`` (flat list) with the same offsets scheme
+    """
+
+    name: str
+    dtype: DataType
+    values: Optional[np.ndarray] = None
+    offsets: Optional[np.ndarray] = None
+    inner_offsets: Optional[np.ndarray] = None
+    blobs: Optional[List[bytes]] = None
+    mask: Optional[np.ndarray] = None  # validity per row
+
+    @property
+    def is_ragged(self) -> bool:
+        return self.offsets is not None
+
+    def row_lengths(self) -> np.ndarray:
+        assert self.offsets is not None
+        return np.diff(self.offsets)
+
+
+@dataclass
+class ColumnarBatch:
+    columns: Dict[str, Column]
+    num_rows: int
+
+    def __getitem__(self, name: str) -> Column:
+        return self.columns[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.columns
+
+
+def _is_bytes_like(dt: DataType) -> bool:
+    return isinstance(dt, (StringType, BinaryType))
+
+
+class _FieldAcc:
+    """Per-field accumulator filled record by record."""
+
+    __slots__ = (
+        "name", "dtype", "np_dtype", "kind", "layout", "nullable",
+        "values", "lengths", "inner_lengths", "blobs", "mask", "decode_str",
+    )
+
+    # layout: 'scalar' | 'ragged' | 'ragged2'
+    def __init__(self, name: str, dtype: DataType, nullable: bool):
+        self.name = name
+        self.dtype = dtype
+        self.nullable = nullable
+        self.decode_str = False
+        elem: DataType = dtype
+        if isinstance(dtype, ArrayType):
+            if isinstance(dtype.element_type, ArrayType):
+                self.layout = "ragged2"
+                elem = dtype.element_type.element_type
+            else:
+                self.layout = "ragged"
+                elem = dtype.element_type
+        else:
+            self.layout = "scalar"
+        if isinstance(elem, ArrayType):
+            raise ValueError(f"column {name}: >2-level nesting unsupported")
+        if isinstance(elem, NullType):
+            self.kind = None
+        elif isinstance(elem, (IntegerType, LongType)):
+            self.kind = proto.INT64_LIST
+        elif isinstance(elem, (FloatType, DoubleType, DecimalType)):
+            self.kind = proto.FLOAT_LIST
+        elif _is_bytes_like(elem):
+            self.kind = proto.BYTES_LIST
+            self.decode_str = False  # keep raw bytes; str decode is a view concern
+        else:
+            raise ValueError(f"column {name}: unsupported element type {elem}")
+        self.np_dtype = numpy_dtype(dtype) if self.kind != proto.BYTES_LIST else None
+        self.values: List = []
+        self.lengths: List[int] = []
+        self.inner_lengths: List[int] = []
+        self.blobs: List[bytes] = []
+        self.mask: List[bool] = []
+
+    # -- per-record appends --------------------------------------------------
+
+    def append_missing(self) -> None:
+        if not self.nullable:
+            raise NullValueError(f"Field {self.name} does not allow null values")
+        self.mask.append(False)
+        if self.layout == "scalar":
+            if self.kind == proto.BYTES_LIST:
+                self.blobs.append(b"")
+            else:
+                self.values.append(0)
+        else:
+            self.lengths.append(0)
+
+    def append_feature(self, feature: proto.Feature) -> None:
+        if feature.kind != self.kind:
+            if feature.kind is None:
+                self.append_missing()
+                return
+            raise ValueError(
+                f"column {self.name}: feature kind {feature.kind_name} does not "
+                f"match schema type {self.dtype}"
+            )
+        vals = feature.values
+        self.mask.append(True)
+        if self.layout == "scalar":
+            if self.kind == proto.BYTES_LIST:
+                self.blobs.append(vals[0] if len(vals) else b"")
+            else:
+                if not len(vals):
+                    raise ValueError(f"column {self.name}: empty feature for scalar")
+                self.values.append(vals[0])
+        elif self.layout == "ragged":
+            self.lengths.append(len(vals))
+            if self.kind == proto.BYTES_LIST:
+                self.blobs.extend(vals)
+            else:
+                self.values.extend(vals)
+        else:
+            raise ValueError(
+                f"column {self.name}: got a flat feature for array-of-array type"
+            )
+
+    def append_feature_list(self, flist: proto.FeatureList) -> None:
+        if self.layout != "ragged2":
+            # A FeatureList can also serve ArrayType(scalar): one scalar per
+            # inner feature (TFRecordDeserializer.scala:129-143).
+            if self.layout == "ragged":
+                self.mask.append(True)
+                self.lengths.append(len(flist.feature))
+                for f in flist.feature:
+                    if f.kind != self.kind:
+                        raise ValueError(
+                            f"column {self.name}: featurelist kind mismatch"
+                        )
+                    if self.kind == proto.BYTES_LIST:
+                        self.blobs.append(f.values[0] if len(f.values) else b"")
+                    else:
+                        self.values.append(f.values[0])
+                return
+            raise ValueError(f"column {self.name}: FeatureList for scalar type")
+        self.mask.append(True)
+        self.lengths.append(len(flist.feature))
+        for f in flist.feature:
+            if f.kind != self.kind:
+                raise ValueError(f"column {self.name}: featurelist kind mismatch")
+            self.inner_lengths.append(len(f.values))
+            if self.kind == proto.BYTES_LIST:
+                self.blobs.extend(f.values)
+            else:
+                self.values.extend(f.values)
+
+    # -- finalize -------------------------------------------------------------
+
+    def build(self, num_rows: int) -> Column:
+        mask = np.asarray(self.mask, dtype=bool)
+        col = Column(self.name, self.dtype, mask=mask)
+        if self.layout == "scalar":
+            if self.kind == proto.BYTES_LIST:
+                col.blobs = self.blobs
+            else:
+                col.values = np.asarray(self.values, dtype=self.np_dtype)
+        elif self.layout == "ragged":
+            col.offsets = np.concatenate(
+                ([0], np.cumsum(np.asarray(self.lengths, dtype=np.int64)))
+            )
+            if self.kind == proto.BYTES_LIST:
+                col.blobs = self.blobs
+            else:
+                col.values = np.asarray(self.values, dtype=self.np_dtype)
+        else:
+            col.offsets = np.concatenate(
+                ([0], np.cumsum(np.asarray(self.lengths, dtype=np.int64)))
+            )
+            col.inner_offsets = np.concatenate(
+                ([0], np.cumsum(np.asarray(self.inner_lengths, dtype=np.int64)))
+            )
+            if self.kind == proto.BYTES_LIST:
+                col.blobs = self.blobs
+            else:
+                col.values = np.asarray(self.values, dtype=self.np_dtype)
+        return col
+
+
+class ColumnarDecoder:
+    """Decode batches of serialized records into a ColumnarBatch.
+
+    The schema plays the role of requiredSchema: features not in the schema
+    are skipped cheaply; schema fields missing from a record follow the null
+    rules (None-able -> masked out, non-nullable -> raise).
+    """
+
+    def __init__(self, schema: StructType, record_type: RecordType = RecordType.EXAMPLE):
+        self.schema = schema
+        self.record_type = RecordType.parse(record_type)
+        if self.record_type == RecordType.BYTE_ARRAY and list(schema.names) != ["byteArray"]:
+            raise ValueError("ByteArray record type requires the single-column schema")
+        # validate eagerly (constructor-time errors like the serializer)
+        for f in schema:
+            _FieldAcc(f.name, f.data_type, f.nullable)
+
+    def decode_batch(self, records: Sequence[bytes]) -> ColumnarBatch:
+        accs = {
+            f.name: _FieldAcc(f.name, f.data_type, f.nullable) for f in self.schema
+        }
+        n = 0
+        if self.record_type == RecordType.BYTE_ARRAY:
+            acc = accs["byteArray"]
+            for rec in records:
+                acc.mask.append(True)
+                acc.blobs.append(bytes(rec))
+                n += 1
+        elif self.record_type == RecordType.EXAMPLE:
+            for rec in records:
+                ex = proto.parse_example(rec)
+                for name, acc in accs.items():
+                    feat = ex.features.get(name)
+                    if feat is None:
+                        acc.append_missing()
+                    else:
+                        acc.append_feature(feat)
+                n += 1
+        else:
+            for rec in records:
+                se = proto.parse_sequence_example(rec)
+                for name, acc in accs.items():
+                    feat = se.context.get(name)
+                    if feat is not None:
+                        acc.append_feature(feat)
+                        continue
+                    flist = se.feature_lists.get(name)
+                    if flist is not None:
+                        acc.append_feature_list(flist)
+                    else:
+                        acc.append_missing()
+                n += 1
+        return ColumnarBatch({name: acc.build(n) for name, acc in accs.items()}, n)
+
+
+# ---------------------------------------------------------------------------
+# Ragged -> dense padding (host-side, numpy)
+# ---------------------------------------------------------------------------
+
+
+def pad_ragged(
+    values: np.ndarray,
+    offsets: np.ndarray,
+    max_len: Optional[int] = None,
+    pad_value: Union[int, float] = 0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Ragged [total] + offsets [N+1] -> dense [N, max_len] + lengths [N].
+
+    Rows longer than max_len are truncated; shorter rows are padded with
+    ``pad_value``. Vectorized (no per-row Python loop).
+    """
+    lengths = np.diff(offsets)
+    n = len(lengths)
+    if max_len is None:
+        max_len = int(lengths.max()) if n else 0
+    clipped = np.minimum(lengths, max_len)
+    dense = np.full((n, max_len), pad_value, dtype=values.dtype if values is not None else np.int64)
+    if n and max_len:
+        # gather indices: for row i, positions offsets[i] .. offsets[i]+clipped[i]
+        col_idx = np.arange(max_len)[None, :]
+        valid = col_idx < clipped[:, None]
+        src = offsets[:-1][:, None] + col_idx
+        dense[valid] = values[src[valid]]
+    return dense, clipped.astype(np.int32)
+
+
+def pad_ragged2(
+    values: np.ndarray,
+    inner_offsets: np.ndarray,
+    row_splits: np.ndarray,
+    max_outer: Optional[int] = None,
+    max_inner: Optional[int] = None,
+    pad_value: Union[int, float] = 0,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Two-level ragged -> dense [N, max_outer, max_inner] + outer lengths
+    [N] + inner lengths [N, max_outer]."""
+    outer_lengths = np.diff(row_splits)
+    n = len(outer_lengths)
+    if max_outer is None:
+        max_outer = int(outer_lengths.max()) if n else 0
+    inner_lengths_flat = np.diff(inner_offsets)
+    if max_inner is None:
+        max_inner = int(inner_lengths_flat.max()) if len(inner_lengths_flat) else 0
+    dense = np.full((n, max_outer, max_inner), pad_value, dtype=values.dtype)
+    inner_len_out = np.zeros((n, max_outer), dtype=np.int32)
+    clipped_outer = np.minimum(outer_lengths, max_outer).astype(np.int32)
+    for i in range(n):
+        for jo, j in enumerate(range(row_splits[i], row_splits[i] + clipped_outer[i])):
+            seg = values[inner_offsets[j] : inner_offsets[j + 1]][:max_inner]
+            dense[i, jo, : len(seg)] = seg
+            inner_len_out[i, jo] = len(seg)
+    return dense, clipped_outer, inner_len_out
+
+
+def bucket_boundaries(lengths: Sequence[int], num_buckets: int = 4) -> List[int]:
+    """Quantile-based bucket boundaries for length-bucketing ragged batches."""
+    if not len(lengths):
+        return []
+    qs = np.quantile(np.asarray(lengths), np.linspace(0, 1, num_buckets + 1)[1:])
+    out: List[int] = []
+    for q in qs:
+        v = int(np.ceil(q))
+        if not out or v > out[-1]:
+            out.append(v)
+    return out

@@ -1,0 +1,121 @@
+"""IO layer: the 'tfrecord' data source (read/write/infer).
+
+The DefaultSource equivalent (reference DefaultSource.scala:23-143 +
+SURVEY.md §2.1): registered under the short name ``tfrecord`` in the format
+registry (the ServiceLoader analog, §2.10), planning reads (schema inference,
+per-shard readers, partition merging) and writes (save modes, partitionBy,
+codecs, atomic commit).
+
+High-level API::
+
+    import tpu_tfrecord.io as tfio
+
+    tfio.write(rows, schema, "/data/out", mode="overwrite",
+               partition_by=["date"], codec="gzip")
+    table = tfio.read("/data/out")            # schema inferred
+    table = tfio.read("/data/out", schema=my_schema, columns=["x", "y"])
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, List, Optional, Sequence
+
+from tpu_tfrecord.io.paths import Shard, discover_shards, has_success_marker
+from tpu_tfrecord.io.reader import DatasetReader, ShardReader
+from tpu_tfrecord.io.table import Table
+from tpu_tfrecord.io.writer import DatasetWriter, ShardWriter, write_dataset
+from tpu_tfrecord.options import TFRecordOptions
+from tpu_tfrecord.registry import register_format
+from tpu_tfrecord.schema import StructType
+
+
+class TFRecordDataSource:
+    """Format plugin: name + planning entry points (ref DefaultSource)."""
+
+    short_name = "tfrecord"
+
+    def infer_schema(self, paths, **options: Any) -> StructType:
+        return DatasetReader(paths, **options).schema()
+
+    def reader(self, paths, **options: Any) -> DatasetReader:
+        return DatasetReader(paths, **options)
+
+    def writer(
+        self,
+        path: str,
+        schema: StructType,
+        mode: str = "error",
+        partition_by: Optional[List[str]] = None,
+        **options: Any,
+    ) -> DatasetWriter:
+        opts = TFRecordOptions.from_map(options)
+        return DatasetWriter(path, schema, opts, partition_by=partition_by, mode=mode)
+
+    # Class-based identity like the reference's equals/hashCode
+    # (DefaultSource.scala:140-142) so registry lookups dedupe.
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, TFRecordDataSource)
+
+    def __hash__(self) -> int:
+        return hash(TFRecordDataSource)
+
+
+register_format(TFRecordDataSource.short_name, TFRecordDataSource)
+
+
+def read(
+    paths,
+    columns: Optional[List[str]] = None,
+    options: Optional[TFRecordOptions] = None,
+    **option_kwargs: Any,
+) -> Table:
+    """Read a TFRecord dataset fully into a Table (schema inferred unless
+    given). For streaming/TPU ingestion use ``reader()`` / tpu_tfrecord.tpu."""
+    r = (
+        DatasetReader(paths, options=options)
+        if options is not None
+        else DatasetReader(paths, **option_kwargs)
+    )
+    schema = r.schema() if columns is None else r.schema().select(columns)
+    return Table(schema, [list(row) for row in r.rows(columns)])
+
+
+def reader(paths, options: Optional[TFRecordOptions] = None, **option_kwargs: Any) -> DatasetReader:
+    if options is not None:
+        return DatasetReader(paths, options=options)
+    return DatasetReader(paths, **option_kwargs)
+
+
+def write(
+    rows: Iterable[Sequence[Any]],
+    schema: StructType,
+    path: str,
+    mode: str = "error",
+    partition_by: Optional[List[str]] = None,
+    options: Optional[TFRecordOptions] = None,
+    **option_kwargs: Any,
+) -> List[str]:
+    if isinstance(rows, Table):
+        schema = rows.schema if schema is None else schema
+        rows = rows.rows
+    return write_dataset(
+        rows, schema, path, mode=mode, partition_by=partition_by,
+        options=options, **option_kwargs,
+    )
+
+
+__all__ = [
+    "TFRecordDataSource",
+    "DatasetReader",
+    "DatasetWriter",
+    "ShardReader",
+    "ShardWriter",
+    "Shard",
+    "Table",
+    "read",
+    "write",
+    "reader",
+    "write_dataset",
+    "discover_shards",
+    "has_success_marker",
+]

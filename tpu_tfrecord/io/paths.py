@@ -1,0 +1,211 @@
+"""Shard discovery and Hive-style partition-directory layout.
+
+Covers what the reference delegates to Hadoop/Spark path machinery: glob
+expansion (README.md: "can accept standard Hadoop globbing expressions"),
+`col=value` partition directories produced by ``partitionBy`` (README.md
+partitionBy example: output dirs ``number=1  number=2  number=8`` plus
+``_SUCCESS``), partition-column value escaping, and partition-column type
+inference on read (Spark's partition discovery infers long/double/string).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+import re
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from tpu_tfrecord.schema import DataType, DoubleType, LongType, StringType
+
+SUCCESS_FILE = "_SUCCESS"
+HIVE_DEFAULT_PARTITION = "__HIVE_DEFAULT_PARTITION__"
+TEMP_PREFIX = "_temporary"
+
+# Characters that must be %-escaped in partition directory names (the set
+# Hive/Spark escape in ExternalCatalogUtils).
+_ESCAPE_CHARS = set('"#%\'*/:=?\\\x7f{[]^')
+
+
+def escape_partition_value(value: str) -> str:
+    out = []
+    for ch in value:
+        if ch in _ESCAPE_CHARS or ord(ch) < 0x20:
+            out.append(f"%{ord(ch):02X}")
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def unescape_partition_value(value: str) -> str:
+    return re.sub("%([0-9A-Fa-f]{2})", lambda m: chr(int(m.group(1), 16)), value)
+
+
+def format_partition_value(value: Any) -> str:
+    """Render a partition value the way Spark renders it into a dir name."""
+    if value is None:
+        return HIVE_DEFAULT_PARTITION
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    if isinstance(value, float):
+        # Spark uses Java Double.toString; Python repr matches for typicals.
+        return repr(value)
+    if isinstance(value, bytes):
+        return value.decode("utf-8", "replace")
+    return str(value)
+
+
+def partition_dir(columns: Sequence[str], values: Sequence[Any]) -> str:
+    """Relative directory path ``c1=v1/c2=v2/...`` for one partition tuple."""
+    parts = []
+    for col, val in zip(columns, values):
+        rendered = format_partition_value(val)
+        if rendered != HIVE_DEFAULT_PARTITION:
+            rendered = escape_partition_value(rendered)
+        parts.append(f"{escape_partition_value(col)}={rendered}")
+    return os.path.join(*parts) if parts else ""
+
+
+def parse_partition_component(component: str) -> Optional[Tuple[str, Optional[str]]]:
+    """Parse one ``col=value`` path component; None if not partition-shaped."""
+    if "=" not in component:
+        return None
+    col, _, raw = component.partition("=")
+    if not col:
+        return None
+    if raw == HIVE_DEFAULT_PARTITION:
+        return unescape_partition_value(col), None
+    return unescape_partition_value(col), unescape_partition_value(raw)
+
+
+def infer_partition_type(values: Iterable[Optional[str]]) -> DataType:
+    """Spark-style partition column type inference: long -> double -> string."""
+    saw_long, saw_double = True, True
+    for v in values:
+        if v is None:
+            continue
+        try:
+            int(v)
+            continue
+        except ValueError:
+            saw_long = False
+        try:
+            float(v)
+        except ValueError:
+            saw_double = False
+            break
+    if saw_long:
+        return LongType()
+    if saw_double:
+        return DoubleType()
+    return StringType()
+
+
+def cast_partition_value(raw: Optional[str], dtype: DataType):
+    if raw is None:
+        return None
+    if isinstance(dtype, LongType):
+        return int(raw)
+    if isinstance(dtype, DoubleType):
+        return float(raw)
+    return raw
+
+
+@dataclass(frozen=True)
+class Shard:
+    """One TFRecord file plus the partition values encoded in its path.
+
+    The unit of parallelism: the reference reads one Spark task per file
+    (isSplitable=false, DefaultSource.scala:26-29); here one shard maps to
+    one slot of the data-parallel mesh axis / one decode worker.
+    """
+
+    path: str
+    size: int
+    partition_values: Tuple[Tuple[str, Optional[str]], ...] = ()
+
+    @property
+    def partitions(self) -> Dict[str, Optional[str]]:
+        return dict(self.partition_values)
+
+
+def is_data_file(name: str) -> bool:
+    """Hidden/metadata files (_SUCCESS, _temporary, .crc...) are not data."""
+    return not (name.startswith("_") or name.startswith("."))
+
+
+def _walk_data_files(root: str) -> Iterable[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if is_data_file(d))
+        for f in sorted(filenames):
+            if is_data_file(f):
+                yield os.path.join(dirpath, f)
+
+
+def expand_paths(paths) -> List[str]:
+    """Expand files/dirs/globs into a flat list of concrete roots."""
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        p = os.fspath(p)
+        if _glob.has_magic(p):
+            matches = sorted(_glob.glob(p))
+            if not matches:
+                raise FileNotFoundError(f"Path does not match any files: {p}")
+            out.extend(matches)
+        else:
+            if not os.path.exists(p):
+                raise FileNotFoundError(f"Path does not exist: {p}")
+            out.append(p)
+    return out
+
+
+def discover_shards(paths) -> List[Shard]:
+    """Find all data files under the input paths, with partition values
+    parsed from ``col=value`` directory components below each root.
+
+    Deterministic order (sorted walk) — the global shard order every host
+    must agree on for multi-host ingestion (SURVEY.md §5 checkpoint plan).
+    """
+    shards: List[Shard] = []
+    for root in expand_paths(paths):
+        if os.path.isfile(root):
+            shards.append(Shard(root, os.path.getsize(root)))
+            continue
+        for fpath in _walk_data_files(root):
+            rel = os.path.relpath(os.path.dirname(fpath), root)
+            pvals: List[Tuple[str, Optional[str]]] = []
+            if rel != ".":
+                for comp in rel.split(os.sep):
+                    parsed = parse_partition_component(comp)
+                    if parsed is not None:
+                        pvals.append(parsed)
+            shards.append(Shard(fpath, os.path.getsize(fpath), tuple(pvals)))
+    return shards
+
+
+def partition_columns_of(shards: Sequence[Shard]) -> List[str]:
+    """Union of partition column names across shards, in first-seen order."""
+    cols: List[str] = []
+    for sh in shards:
+        for col, _ in sh.partition_values:
+            if col not in cols:
+                cols.append(col)
+    return cols
+
+
+def new_shard_filename(task_id: int, ext: str, job_uuid: Optional[str] = None) -> str:
+    """Spark-style part-file name: ``part-00000-<uuid>.tfrecord[.gz]``."""
+    job_uuid = job_uuid or uuid.uuid4().hex
+    return f"part-{task_id:05d}-{job_uuid}{ext}"
+
+
+def has_success_marker(path: str) -> bool:
+    return os.path.exists(os.path.join(path, SUCCESS_FILE))
+
+
+def write_success_marker(path: str) -> None:
+    with open(os.path.join(path, SUCCESS_FILE), "wb"):
+        pass

@@ -1,0 +1,262 @@
+"""Dataset reader: per-shard record iterators + partition-aware scans.
+
+TPU-native re-implementation of the reference's read path (SURVEY.md §3.1):
+DefaultSource.buildReader + TFRecordFileReader. One ShardReader per file
+(the reference's one-Spark-task-per-file unit, isSplitable=false at
+DefaultSource.scala:26-29), opened lazily, closed eagerly at EOF and
+guaranteed closed via context-manager/close() (mirroring the task-completion
+listener + early close at TFRecordFileReader.scala:34-57).
+
+Partition columns parsed from ``col=value`` directories are appended to each
+row (Spark does this in FileScanRDD outside the connector; here it is
+explicit), with Spark-style type inference (long -> double -> string).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from tpu_tfrecord import wire
+from tpu_tfrecord.infer import infer_from_records, merge_type_maps, type_map_to_schema
+from tpu_tfrecord.io import paths as p
+from tpu_tfrecord.io.paths import Shard
+from tpu_tfrecord.metrics import METRICS
+from tpu_tfrecord.options import RecordType, TFRecordOptions
+from tpu_tfrecord.schema import StructField, StructType
+from tpu_tfrecord.serde import Row, TFRecordDeserializer, decode_record
+
+
+class ShardReader:
+    """Lazy iterator of rows from one TFRecord shard.
+
+    The TFRecordFileReader equivalent: opens the (possibly compressed) stream
+    on first ``next()``, decodes each record through the schema-driven
+    deserializer, closes eagerly at EOF, and is safe to close twice.
+    """
+
+    def __init__(
+        self,
+        shard: Shard,
+        data_schema: StructType,
+        options: TFRecordOptions,
+        partition_tail: Sequence[Any] = (),
+    ):
+        self.shard = shard
+        self._options = options
+        self._deserializer = TFRecordDeserializer(data_schema)
+        self._partition_tail = list(partition_tail)
+        self._fh = None
+        self._reader = None
+        self._closed = False
+
+    def _ensure_open(self) -> None:
+        if self._reader is None and not self._closed:
+            codec = wire.codec_from_path(self.shard.path)
+            self._fh = wire.open_compressed(self.shard.path, "rb", codec)
+            self._reader = wire.RecordReader(self._fh, verify_crc=self._options.verify_crc)
+
+    def close(self) -> None:
+        self._closed = True
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            finally:
+                self._fh = None
+                self._reader = None
+
+    def __enter__(self) -> "ShardReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __iter__(self) -> Iterator[Row]:
+        self._ensure_open()
+        if self._reader is None:
+            return
+        record_type = self._options.record_type
+        tail = self._partition_tail
+        # Time only the fetch+decode work, never the time the generator
+        # spends suspended at yield (consumer compute is not read time).
+        records = 0
+        nbytes = 0
+        seconds = 0.0
+        clock = time.perf_counter
+        try:
+            while True:
+                t0 = clock()
+                record = self._reader.read()
+                if record is None:
+                    seconds += clock() - t0
+                    break
+                row = decode_record(self._deserializer, record_type, record)
+                seconds += clock() - t0
+                records += 1
+                nbytes += len(record)
+                if tail:
+                    row = row + tail
+                yield row
+        finally:
+            self.close()
+            METRICS.add("read", records=records, nbytes=nbytes, seconds=seconds)
+
+
+class DatasetReader:
+    """Plan + execute a read over many shards with partition merging.
+
+    The planning half mirrors DefaultSource.inferSchema/buildReader
+    (DefaultSource.scala:31-39, 118-136); execution iterates shards in the
+    deterministic discovery order.
+    """
+
+    def __init__(self, paths_in, options: Optional[TFRecordOptions] = None, **option_kwargs):
+        self.options = options or TFRecordOptions.from_map(option_kwargs)
+        self.shards = p.discover_shards(paths_in)
+        self._partition_cols = p.partition_columns_of(self.shards)
+        self._partition_types = {
+            col: p.infer_partition_type(
+                sh.partitions.get(col) for sh in self.shards
+            )
+            for col in self._partition_cols
+        }
+        self._schema: Optional[StructType] = None
+
+    # -- schema -------------------------------------------------------------
+
+    @property
+    def partition_schema(self) -> StructType:
+        return StructType(
+            [
+                StructField(c, self._partition_types[c], True)
+                for c in self._partition_cols
+            ]
+        )
+
+    def schema(self) -> StructType:
+        """Full schema: data schema + appended partition columns.
+
+        If the user supplied a schema it wins (reference: user schema skips
+        inference, DefaultSource.scala:31-39); partition columns the user did
+        not mention are appended.
+        """
+        if self._schema is not None:
+            return self._schema
+        if self.options.schema is not None:
+            base = self.options.schema
+        else:
+            base = self._infer_data_schema()
+        fields = list(base.fields)
+        names = {f.name for f in fields}
+        for col in self._partition_cols:
+            if col not in names:
+                fields.append(StructField(col, self._partition_types[col], True))
+        self._schema = StructType(fields)
+        return self._schema
+
+    def data_schema(self) -> StructType:
+        """Schema of what is physically inside the records (partition
+        columns excluded)."""
+        return self.schema().drop(self._partition_cols)
+
+    def _infer_data_schema(self) -> StructType:
+        """First non-empty file whose records yield a non-empty schema —
+        single scan per candidate file (the reference scans the winning file
+        twice via hasSchema + getSchemaFromFile, DefaultSource.scala:36-37;
+        we keep the first scan's result)."""
+        if self.options.record_type == RecordType.BYTE_ARRAY:
+            from tpu_tfrecord.infer import byte_array_schema
+
+            return byte_array_schema()
+        limit = self.options.infer_sample_limit
+        for shard in self.shards:
+            if shard.size == 0:
+                continue
+            type_map = infer_from_records(
+                wire.read_records(
+                    shard.path, verify_crc=self.options.verify_crc
+                ),
+                self.options.record_type,
+                limit=limit,
+            )
+            if type_map:
+                return type_map_to_schema(type_map)
+        raise ValueError(
+            "Could not infer schema: no non-empty TFRecord file found under "
+            f"{[s.path for s in self.shards][:5]}..."
+            if self.shards
+            else "Could not infer schema: no input files"
+        )
+
+    def infer_schema_all_files(self) -> StructType:
+        """Inference over EVERY shard with the distributed merge algebra —
+        the standalone TensorFlowInferSchema entry (SURVEY.md §3.3), and the
+        per-host seqOp/combOp used by the multi-host path."""
+        merged: Dict[str, Any] = {}
+        for shard in self.shards:
+            partial = infer_from_records(
+                wire.read_records(shard.path, verify_crc=self.options.verify_crc),
+                self.options.record_type,
+                limit=self.options.infer_sample_limit,
+            )
+            merged = merge_type_maps(merged, partial)
+        return type_map_to_schema(merged)
+
+    # -- execution ----------------------------------------------------------
+
+    def _shard_reader(
+        self, shard: Shard, data_schema: StructType, required_partitions: List[str]
+    ) -> ShardReader:
+        tail = [
+            p.cast_partition_value(
+                shard.partitions.get(col), self._partition_types[col]
+            )
+            for col in required_partitions
+        ]
+        return ShardReader(shard, data_schema, self.options, tail)
+
+    def readers(self, columns: Optional[List[str]] = None) -> List[ShardReader]:
+        """One lazy reader per shard. ``columns`` prunes the schema the way
+        Spark pushes requiredSchema into buildReader (DefaultSource.scala:131)."""
+        full = self.schema()
+        if columns is not None:
+            required = full.select(columns)
+        else:
+            required = full
+        part_set = set(self._partition_cols)
+        data_schema = StructType([f for f in required if f.name not in part_set])
+        required_partitions = [f.name for f in required if f.name in part_set]
+        # Rows come out as data columns (in required order) + partition tail;
+        # reorder to the exact required order if partitions interleave.
+        readers = [
+            self._shard_reader(sh, data_schema, required_partitions)
+            for sh in self.shards
+        ]
+        out_order = [f.name for f in data_schema] + required_partitions
+        want = [f.name for f in required]
+        if out_order != want:
+            perm = [out_order.index(n) for n in want]
+            return [_ReorderingReader(r, perm) for r in readers]  # type: ignore[list-item]
+        return readers
+
+    def rows(self, columns: Optional[List[str]] = None) -> Iterator[Row]:
+        for reader in self.readers(columns):
+            yield from reader
+
+
+class _ReorderingReader:
+    """Wraps a ShardReader permuting each row to the required column order."""
+
+    def __init__(self, inner: ShardReader, perm: List[int]):
+        self._inner = inner
+        self._perm = perm
+        self.shard = inner.shard
+
+    def close(self) -> None:
+        self._inner.close()
+
+    def __iter__(self) -> Iterator[Row]:
+        perm = self._perm
+        for row in self._inner:
+            yield [row[i] for i in perm]

@@ -1,0 +1,43 @@
+"""A minimal in-memory table: rows + schema.
+
+The DataFrame stand-in for tests and small jobs — the reference's user-facing
+currency is a Spark DataFrame; the TPU framework's real currency is columnar
+batches feeding jax.Array (tpu_tfrecord.columnar / tpu_tfrecord.tpu), but a
+row-oriented Table keeps API parity for the long tail of uses (round-trip
+tests, inspection, small exports).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Iterator, List, Optional, Sequence
+
+from tpu_tfrecord.schema import StructType
+
+
+@dataclass
+class Table:
+    schema: StructType
+    rows: List[List[Any]]
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def __iter__(self) -> Iterator[List[Any]]:
+        return iter(self.rows)
+
+    def column(self, name: str) -> List[Any]:
+        idx = self.schema.field_index(name)
+        return [row[idx] for row in self.rows]
+
+    def select(self, names: Sequence[str]) -> "Table":
+        idxs = [self.schema.field_index(n) for n in names]
+        return Table(self.schema.select(list(names)), [[r[i] for i in idxs] for r in self.rows])
+
+    def sort_by(self, name: str) -> "Table":
+        idx = self.schema.field_index(name)
+        return Table(self.schema, sorted(self.rows, key=lambda r: (r[idx] is None, r[idx])))
+
+    def to_dicts(self) -> List[dict]:
+        names = self.schema.names
+        return [dict(zip(names, row)) for row in self.rows]

@@ -1,0 +1,230 @@
+"""Dataset writer: save modes, Hive-style partitionBy, atomic commit.
+
+TPU-native re-implementation of the reference's write path (SURVEY.md §3.2):
+what Spark's FileFormatWriter + DefaultSource.prepareWrite +
+TFRecordOutputWriter do together —
+
+- save modes overwrite / append / ignore / error  (Spark semantics, pinned by
+  reference TFRecordIOSuite.scala:184-237)
+- ``partitionBy`` routes rows into ``col=value`` directories with the
+  partition columns STRIPPED from the written records (README.md:195-207)
+- per-shard writers with codec-compressed streams and '.tfrecord' + codec
+  extension file names (DefaultSource.scala:105-114,
+  TFRecordOutputWriter.scala:12-43)
+- job-level atomicity: shards are written under ``_temporary/<job>/`` and
+  moved into place on commit, then a ``_SUCCESS`` marker is written — the
+  idempotent-commit plan from SURVEY.md §5 (the reference gets this from
+  Spark's commit protocol).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import uuid
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from tpu_tfrecord import wire
+from tpu_tfrecord.io import paths as p
+from tpu_tfrecord.metrics import METRICS, timed
+from tpu_tfrecord.options import RecordType, TFRecordOptions
+from tpu_tfrecord.schema import StructType
+from tpu_tfrecord.serde import TFRecordSerializer, encode_row
+
+SAVE_MODES = ("error", "errorifexists", "overwrite", "append", "ignore")
+
+
+class ShardWriter:
+    """Per-shard output file: serialize each row, frame it, stream it out.
+
+    The TFRecordOutputWriter equivalent (reference TFRecordOutputWriter.scala:
+    12-44): one instance per (task, partition-dir), owning one output stream.
+    """
+
+    def __init__(self, path: str, schema: StructType, options: TFRecordOptions):
+        self.path = path
+        self._serializer = TFRecordSerializer(schema)
+        self._record_type = options.record_type
+        self._fh = wire.open_compressed(path, "wb", options.codec)
+        self._writer = wire.RecordWriter(self._fh)
+
+    def write(self, row: Sequence[Any]) -> None:
+        self._writer.write(encode_row(self._serializer, self._record_type, row))
+
+    def write_serialized(self, record: bytes) -> None:
+        self._writer.write(record)
+
+    @property
+    def records_written(self) -> int:
+        return self._writer.records_written
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+class DatasetWriter:
+    """Partition-aware, save-mode-aware dataset writer."""
+
+    def __init__(
+        self,
+        output_path: str,
+        schema: StructType,
+        options: Optional[TFRecordOptions] = None,
+        partition_by: Optional[List[str]] = None,
+        mode: str = "error",
+        max_records_per_file: Optional[int] = None,
+    ):
+        mode = (mode or "error").lower()
+        if mode not in SAVE_MODES:
+            raise ValueError(f"Unknown save mode {mode!r}; one of {SAVE_MODES}")
+        self.output_path = os.fspath(output_path)
+        self.options = options or TFRecordOptions()
+        self.mode = mode
+        self.partition_by = list(partition_by or [])
+        self.max_records_per_file = max_records_per_file
+        self.schema = schema
+        for col in self.partition_by:
+            if col not in schema:
+                raise ValueError(f"partitionBy column {col!r} not in schema")
+        if self.partition_by and len(self.partition_by) == len(schema):
+            raise ValueError("cannot use all columns as partition columns")
+        # Partition columns are stripped from the written records — the data
+        # schema is the remainder (Spark strips them before the writer;
+        # SURVEY.md §3.2 process-boundary note).
+        self.data_schema = schema.drop(self.partition_by)
+        self._pidx = [schema.field_index(c) for c in self.partition_by]
+        self._didx = [
+            i for i in range(len(schema)) if i not in set(self._pidx)
+        ]
+
+    # -- save-mode gate -----------------------------------------------------
+
+    def _prepare_output(self) -> bool:
+        """Apply save-mode semantics. Returns False if the write is a no-op
+        (mode=ignore with existing output)."""
+        out = self.output_path
+        exists = os.path.exists(out) and (
+            not os.path.isdir(out) or any(p.is_data_file(f) for f in os.listdir(out))
+        )
+        if exists:
+            if self.mode in ("error", "errorifexists"):
+                raise FileExistsError(
+                    f"path {out} already exists (save mode: ErrorIfExists)"
+                )
+            if self.mode == "ignore":
+                return False
+            if self.mode == "overwrite":
+                if os.path.isdir(out):
+                    shutil.rmtree(out)
+                else:
+                    os.remove(out)
+        os.makedirs(out, exist_ok=True)
+        return True
+
+    # -- the write job ------------------------------------------------------
+
+    def write_rows(self, rows: Iterable[Sequence[Any]], task_id: int = 0) -> List[str]:
+        """Write all rows as one logical job; returns final shard paths."""
+        if not self._prepare_output():
+            return []
+        job = uuid.uuid4().hex[:12]
+        temp_root = os.path.join(self.output_path, p.TEMP_PREFIX, job)
+        os.makedirs(temp_root, exist_ok=True)
+        ext = self.options.file_extension()
+        writers: Dict[str, ShardWriter] = {}
+        seq: Dict[str, int] = {}
+        final_of: Dict[str, str] = {}
+        # Shards closed mid-job (max_records_per_file rollover) stay under
+        # _temporary until the single end-of-job commit — a failed job must
+        # leave NOTHING in the final directory.
+        pending_commit: List[str] = []
+        try:
+            with timed("write", METRICS) as t:
+                for row in rows:
+                    rel = self._partition_rel_dir(row)
+                    key = rel
+                    w = writers.get(key)
+                    if w is not None and (
+                        self.max_records_per_file
+                        and w.records_written >= self.max_records_per_file
+                    ):
+                        w.close()
+                        pending_commit.append(w.path)
+                        w = None
+                        writers.pop(key)
+                    if w is None:
+                        n = seq.get(key, 0)
+                        seq[key] = n + 1
+                        fname = p.new_shard_filename(task_id, f".c{n:03d}{ext}", job)
+                        tmp_dir = os.path.join(temp_root, rel) if rel else temp_root
+                        os.makedirs(tmp_dir, exist_ok=True)
+                        tmp_path = os.path.join(tmp_dir, fname)
+                        final_dir = (
+                            os.path.join(self.output_path, rel)
+                            if rel
+                            else self.output_path
+                        )
+                        final_of[tmp_path] = os.path.join(final_dir, fname)
+                        w = writers[key] = ShardWriter(
+                            tmp_path, self.data_schema, self.options
+                        )
+                    w.write(self._strip_partitions(row))
+                    t.records += 1
+            for w in writers.values():
+                w.close()
+                pending_commit.append(w.path)
+            written = []
+            for tmp_path in pending_commit:
+                self._commit_shard(tmp_path, final_of[tmp_path])
+                written.append(final_of[tmp_path])
+        except Exception:
+            for w in writers.values():
+                try:
+                    w.close()
+                except Exception:
+                    pass
+            # Remove only THIS job's temp dir: other concurrent tasks may
+            # have jobs in flight under the shared _temporary root.
+            shutil.rmtree(temp_root, ignore_errors=True)
+            raise
+        shutil.rmtree(temp_root, ignore_errors=True)
+        temp_parent = os.path.join(self.output_path, p.TEMP_PREFIX)
+        try:
+            os.rmdir(temp_parent)  # only if no other job is using it
+        except OSError:
+            pass
+        p.write_success_marker(self.output_path)
+        return written
+
+    def _partition_rel_dir(self, row: Sequence[Any]) -> str:
+        if not self.partition_by:
+            return ""
+        return p.partition_dir(self.partition_by, [row[i] for i in self._pidx])
+
+    def _strip_partitions(self, row: Sequence[Any]) -> List[Any]:
+        if not self.partition_by:
+            return list(row)
+        return [row[i] for i in self._didx]
+
+    @staticmethod
+    def _commit_shard(tmp_path: str, final_path: str) -> None:
+        """Idempotent shard commit: atomic rename into place."""
+        os.makedirs(os.path.dirname(final_path), exist_ok=True)
+        os.replace(tmp_path, final_path)
+
+
+def write_dataset(
+    rows: Iterable[Sequence[Any]],
+    schema: StructType,
+    path: str,
+    mode: str = "error",
+    partition_by: Optional[List[str]] = None,
+    options: Optional[TFRecordOptions] = None,
+    **option_kwargs: Any,
+) -> List[str]:
+    """One-call write API: ``write_dataset(rows, schema, path,
+    mode='overwrite', partition_by=['date'], recordType='Example',
+    codec='gzip')``."""
+    opts = options or TFRecordOptions.from_map(option_kwargs)
+    writer = DatasetWriter(path, schema, opts, partition_by=partition_by, mode=mode)
+    return writer.write_rows(rows)

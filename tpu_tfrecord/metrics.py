@@ -1,0 +1,91 @@
+"""Throughput counters and structured logging.
+
+The reference has no observability of its own (SURVEY.md §5: tracing ABSENT,
+metrics ride on Spark's UI). Here per-stage counters are first-class because
+records/sec and bytes/sec into the device ARE the north-star metric
+(BASELINE.md). Counters are cheap (updated at batch granularity, never per
+record) and thread-safe.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+logger = logging.getLogger("tpu_tfrecord")
+
+
+@dataclass
+class StageStats:
+    records: int = 0
+    bytes: int = 0
+    batches: int = 0
+    seconds: float = 0.0
+
+    def throughput(self) -> Dict[str, float]:
+        dt = self.seconds or 1e-9
+        return {
+            "records_per_sec": self.records / dt,
+            "bytes_per_sec": self.bytes / dt,
+            "records": self.records,
+            "bytes": self.bytes,
+            "batches": self.batches,
+            "seconds": self.seconds,
+        }
+
+
+class Metrics:
+    """Registry of per-stage counters (read, decode, h2d, write, ...)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stages: Dict[str, StageStats] = {}
+
+    def add(self, stage: str, records: int = 0, nbytes: int = 0, seconds: float = 0.0) -> None:
+        with self._lock:
+            st = self._stages.setdefault(stage, StageStats())
+            st.records += records
+            st.bytes += nbytes
+            st.batches += 1
+            st.seconds += seconds
+
+    def stage(self, stage: str) -> StageStats:
+        with self._lock:
+            return self._stages.setdefault(stage, StageStats())
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {name: st.throughput() for name, st in self._stages.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stages.clear()
+
+
+# Process-global default registry.
+METRICS = Metrics()
+
+
+class timed:
+    """Context manager adding elapsed wall time (and counts) to a stage."""
+
+    def __init__(self, stage: str, metrics: Optional[Metrics] = None):
+        self.stage = stage
+        self.metrics = metrics or METRICS
+        self.records = 0
+        self.bytes = 0
+
+    def __enter__(self) -> "timed":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.metrics.add(
+            self.stage,
+            records=self.records,
+            nbytes=self.bytes,
+            seconds=time.perf_counter() - self._t0,
+        )

@@ -1,0 +1,29 @@
+"""Reference consumers of the ingestion pipeline.
+
+The reference framework ships no models (SURVEY.md §2: model-side parallelism
+N/A) — its output is consumed by TensorFlow training jobs. Here the flagship
+consumer is in-tree: a Criteo-style DLRM (the BASELINE.md north-star workload
+is Criteo-1TB ingest) whose training step exercises every mesh axis the
+ingest layer produces: batch on 'data' (DP), embedding tables and hidden
+layers on 'model' (TP), padded sequence features on 'seq' (SP).
+"""
+
+from tpu_tfrecord.models.dlrm import (
+    DLRMConfig,
+    forward,
+    init_params,
+    loss_fn,
+    make_synthetic_batch,
+    param_shardings,
+    train_step,
+)
+
+__all__ = [
+    "DLRMConfig",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "train_step",
+    "param_shardings",
+    "make_synthetic_batch",
+]

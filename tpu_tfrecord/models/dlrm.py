@@ -1,0 +1,197 @@
+"""Criteo-style DLRM: the flagship consumer of the ingest pipeline.
+
+Pure-JAX functional model (params are a plain pytree) designed TPU-first:
+
+- all compute is batched matmuls/gathers that tile onto the MXU; bfloat16
+  activations with float32 params/accumulation;
+- embedding tables are sharded over the 'model' mesh axis (row/vocab dim) —
+  gathers on a sharded table make XLA insert the all-to-all/allgather
+  collectives (tensor parallelism over ICI);
+- an optional sequence tower consumes padded SequenceExample frames
+  [B, L, D] with L shardable over a 'seq' axis (sequence/context
+  parallelism for the long-context path);
+- the train step is a single jit: loss -> grad -> optax update, donated
+  params, no data-dependent Python control flow.
+
+Batch layout matches tpu_tfrecord.tpu.ingest.host_batch_from_columnar output
+for a Criteo-like schema: 'dense' [B, 13] f32, 'cat' [B, 26] i64 (hashed),
+'label' [B] f32, optionally 'frames' [B, L, D] + 'frames_len' [B].
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class DLRMConfig:
+    num_dense: int = 13
+    num_categorical: int = 26
+    vocab_size: int = 1024          # per-feature hash buckets
+    embed_dim: int = 32
+    bottom_mlp: Tuple[int, ...] = (64, 32)
+    top_mlp: Tuple[int, ...] = (64, 1)
+    seq_len: int = 0                # 0 = no sequence tower
+    seq_dim: int = 0
+    dtype: Any = jnp.bfloat16       # activation dtype (MXU-friendly)
+
+
+def _dense_init(rng, fan_in: int, fan_out: int):
+    scale = np.sqrt(2.0 / fan_in)
+    w = jax.random.normal(rng, (fan_in, fan_out), jnp.float32) * scale
+    return {"w": w, "b": jnp.zeros((fan_out,), jnp.float32)}
+
+
+def init_params(rng: jax.Array, cfg: DLRMConfig) -> Dict[str, Any]:
+    keys = jax.random.split(rng, 8)
+    params: Dict[str, Any] = {
+        # one stacked table [F, V, D]: a single large gather beats F small
+        # ones (fewer kernels, better HBM streaming)
+        "embeddings": jax.random.normal(
+            keys[0], (cfg.num_categorical, cfg.vocab_size, cfg.embed_dim), jnp.float32
+        )
+        * 0.05,
+    }
+    bottom = []
+    fan = cfg.num_dense
+    for i, width in enumerate(cfg.bottom_mlp):
+        bottom.append(_dense_init(jax.random.fold_in(keys[1], i), fan, width))
+        fan = width
+    params["bottom"] = bottom
+    interact_dim = cfg.bottom_mlp[-1] + cfg.num_categorical * cfg.embed_dim
+    if cfg.seq_len:
+        interact_dim += cfg.embed_dim
+        params["seq_proj"] = _dense_init(keys[3], cfg.seq_dim, cfg.embed_dim)
+    top = []
+    fan = interact_dim
+    for i, width in enumerate(cfg.top_mlp):
+        top.append(_dense_init(jax.random.fold_in(keys[2], i), fan, width))
+        fan = width
+    params["top"] = top
+    return params
+
+
+def _mlp(layers, x, dtype):
+    for i, layer in enumerate(layers):
+        x = x @ layer["w"].astype(dtype) + layer["b"].astype(dtype)
+        if i + 1 < len(layers):
+            x = jax.nn.relu(x)
+    return x
+
+
+def forward(params: Dict[str, Any], batch: Dict[str, jax.Array], cfg: DLRMConfig) -> jax.Array:
+    """Logits [B]. bfloat16 activations, float32 output."""
+    dt = cfg.dtype
+    dense = batch["dense"].astype(dt)
+    bottom_out = _mlp(params["bottom"], dense, dt)          # [B, H]
+    # [B, F] indices into [F, V, D] -> [B, F, D]
+    emb = jnp.take_along_axis(
+        params["embeddings"].astype(dt)[None],              # [1, F, V, D]
+        batch["cat"][:, :, None, None],                      # [B, F, 1, 1]
+        axis=2,
+    )[:, :, 0, :]
+    feats = [bottom_out, emb.reshape(emb.shape[0], -1)]
+    if cfg.seq_len:
+        frames = batch["frames"].astype(dt)                  # [B, L, D_in]
+        proj = _mlp([params["seq_proj"]], frames, dt)        # [B, L, D]
+        mask = (
+            jnp.arange(frames.shape[1])[None, :] < batch["frames_len"][:, None]
+        ).astype(dt)
+        pooled = (proj * mask[:, :, None]).sum(axis=1) / jnp.maximum(
+            mask.sum(axis=1, keepdims=True), 1.0
+        )
+        feats.append(pooled)
+    x = jnp.concatenate(feats, axis=-1)
+    logits = _mlp(params["top"], x, dt)
+    return logits[:, 0].astype(jnp.float32)
+
+
+def loss_fn(params, batch, cfg: DLRMConfig) -> jax.Array:
+    logits = forward(params, batch, cfg)
+    labels = batch["label"].astype(jnp.float32)
+    # numerically-stable BCE-with-logits
+    return jnp.mean(
+        jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    )
+
+
+def train_step(params, opt_state, batch, cfg: DLRMConfig, tx):
+    """One SGD step: loss -> grad -> optax update. Jit this whole function."""
+    loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = jax.tree.map(lambda p, u: p + u, params, updates)
+    return params, opt_state, loss
+
+
+# ---------------------------------------------------------------------------
+# Shardings
+# ---------------------------------------------------------------------------
+
+
+def param_shardings(mesh: Mesh, params, model_axis: str = "model"):
+    """Tensor-parallel layout: embedding tables sharded over the vocab dim,
+    MLP hidden dims sharded over 'model', biases/small tensors replicated."""
+    has_model = model_axis in mesh.shape and mesh.shape[model_axis] > 1
+
+    axis_size = mesh.shape.get(model_axis, 1)
+
+    def spec_of(path: Tuple[str, ...], leaf) -> NamedSharding:
+        if not has_model:
+            return NamedSharding(mesh, P())
+        name = "/".join(str(p) for p in path)
+        if name.startswith("embeddings") and leaf.shape[1] % axis_size == 0:
+            return NamedSharding(mesh, P(None, model_axis, None))  # [F, V@model, D]
+        if name.startswith("embeddings"):
+            return NamedSharding(mesh, P())
+        if leaf.ndim == 2 and leaf.shape[1] % axis_size == 0:
+            return NamedSharding(mesh, P(None, model_axis))        # [in, out@model]
+        return NamedSharding(mesh, P())
+
+    def walk(tree, path=()):
+        if isinstance(tree, dict):
+            return {k: walk(v, path + (k,)) for k, v in tree.items()}
+        if isinstance(tree, list):
+            return [walk(v, path + (i,)) for i, v in enumerate(tree)]
+        return spec_of(path, tree)
+
+    return walk(params)
+
+
+def batch_shardings(mesh: Mesh, batch, data_axis: str = "data", seq_axis: Optional[str] = None):
+    """Batch dim on 'data'; optionally the sequence (L) dim of 3-D features
+    on a 'seq' axis — sequence/context parallelism for long sequences."""
+    out = {}
+    for name, arr in batch.items():
+        if arr.ndim >= 2 and seq_axis and name == "frames" and seq_axis in mesh.shape:
+            out[name] = NamedSharding(mesh, P(data_axis, seq_axis, *([None] * (arr.ndim - 2))))
+        else:
+            out[name] = NamedSharding(mesh, P(data_axis, *([None] * (arr.ndim - 1))))
+    return out
+
+
+def make_synthetic_batch(
+    cfg: DLRMConfig, batch_size: int, seed: int = 0
+) -> Dict[str, np.ndarray]:
+    """Deterministic synthetic Criteo-like host batch (numpy, host-side)."""
+    rng = np.random.default_rng(seed)
+    batch = {
+        "dense": rng.normal(size=(batch_size, cfg.num_dense)).astype(np.float32),
+        "cat": rng.integers(
+            0, cfg.vocab_size, size=(batch_size, cfg.num_categorical), dtype=np.int64
+        ),
+        "label": rng.integers(0, 2, size=(batch_size,)).astype(np.float32),
+    }
+    if cfg.seq_len:
+        batch["frames"] = rng.normal(
+            size=(batch_size, cfg.seq_len, cfg.seq_dim)
+        ).astype(np.float32)
+        batch["frames_len"] = rng.integers(
+            1, cfg.seq_len + 1, size=(batch_size,)
+        ).astype(np.int32)
+    return batch

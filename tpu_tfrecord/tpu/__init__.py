@@ -1,0 +1,35 @@
+"""TPU ingestion layer: datasets -> sharded jax.Array on a device mesh.
+
+This is where the reference's data-parallel story (one Spark task per file,
+SURVEY.md §2 parallelism table) becomes a TPU pod's: shards are assigned
+per host, each host decodes its shards into columnar host batches, and
+`jax.make_array_from_process_local_data` assembles global arrays sharded over
+the mesh's 'data' axis. Ragged SequenceExample columns pad/bucket into dense
+[batch, max_len] device arrays.
+"""
+
+from tpu_tfrecord.tpu.mesh import (
+    assign_shards,
+    create_mesh,
+    data_sharding,
+    local_batch_size,
+)
+from tpu_tfrecord.tpu.ingest import (
+    DeviceIterator,
+    batch_spec,
+    hash_bytes_column,
+    host_batch_from_columnar,
+    make_global_batch,
+)
+
+__all__ = [
+    "create_mesh",
+    "data_sharding",
+    "assign_shards",
+    "local_batch_size",
+    "batch_spec",
+    "host_batch_from_columnar",
+    "make_global_batch",
+    "hash_bytes_column",
+    "DeviceIterator",
+]

@@ -1,0 +1,103 @@
+"""Multi-host coordination over jax.distributed.
+
+The reference's distributed backend is Spark's: driver->executor broadcast of
+the job conf + RDD aggregate tree-merge for schema inference (SURVEY.md §2
+parallelism table, §5). The TPU-native equivalents:
+
+- process coordination: ``jax.distributed.initialize`` (DCN); collectives on
+  data ride ICI only inside jit-compiled computations.
+- conf shipping: TFRecordOptions is a plain picklable value (options.py); no
+  broadcast machinery is needed because every host derives identical state
+  deterministically (same paths -> same sorted shard list -> same
+  assignment).
+- schema-inference merge: each host computes a partial type map over ITS
+  shards (the seqOp of TensorFlowInferSchema.scala:40-43), then the JSON-coded
+  partials are allgathered over the mesh and every host applies the same
+  deterministic combOp merge — no host-0 special case, no extra broadcast.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from tpu_tfrecord.infer import TypeMap, merge_type_maps, type_map_to_schema
+from tpu_tfrecord.schema import DataType, StructType, data_type_from_json
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize multi-host JAX if needed; safe no-op when single-process."""
+    if num_processes in (None, 1) and coordinator_address is None:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def _encode_type_map(type_map: TypeMap) -> bytes:
+    obj = {
+        name: (None if dtype is None else dtype.to_json())
+        for name, dtype in type_map.items()
+    }
+    return json.dumps(obj, sort_keys=True).encode("utf-8")
+
+
+def _decode_type_map(data: bytes) -> TypeMap:
+    obj = json.loads(data.decode("utf-8"))
+    return {
+        name: (None if t is None else data_type_from_json(t))
+        for name, t in obj.items()
+    }
+
+
+def allgather_bytes(payload: bytes) -> List[bytes]:
+    """Allgather a variable-length byte string across processes.
+
+    Two phases over jax.experimental.multihost_utils.process_allgather:
+    lengths first (so every host can size the padded buffer), then the padded
+    payload bytes. Single-process: identity.
+    """
+    if jax.process_count() == 1:
+        return [payload]
+    from jax.experimental import multihost_utils
+
+    lengths = multihost_utils.process_allgather(
+        np.asarray([len(payload)], dtype=np.int32)
+    ).reshape(-1)
+    max_len = int(lengths.max())
+    padded = np.zeros(max_len, dtype=np.uint8)
+    padded[: len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+    gathered = multihost_utils.process_allgather(padded)
+    gathered = np.asarray(gathered).reshape(jax.process_count(), max_len)
+    return [bytes(gathered[i, : int(lengths[i])].tobytes()) for i in range(len(lengths))]
+
+
+def merge_schema_across_hosts(local_type_map: TypeMap) -> StructType:
+    """Distributed schema inference: allgather per-host partial type maps and
+    fold them with the same combOp on every host (deterministic order ->
+    identical result everywhere). The TPU-native analog of the reference's
+    RDD.aggregate combOp tree-merge (TensorFlowInferSchema.scala:40-43)."""
+    partials = [
+        _decode_type_map(p) for p in allgather_bytes(_encode_type_map(local_type_map))
+    ]
+    merged: TypeMap = {}
+    for partial in partials:
+        merged = merge_type_maps(merged, partial)
+    return type_map_to_schema(merged)
+
+
+def assert_same_across_hosts(value: bytes, what: str = "value") -> None:
+    """Cheap cross-host consistency check (e.g. schema JSON, shard-list
+    digest) — catches divergent host state before it corrupts a run."""
+    gathered = allgather_bytes(value)
+    if any(g != value for g in gathered):
+        raise RuntimeError(f"{what} differs across hosts")

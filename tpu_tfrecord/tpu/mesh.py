@@ -1,0 +1,80 @@
+"""Device mesh construction and shard assignment.
+
+The data-parallel axis here is the TPU-native re-design of the reference's
+parallelism model (one Spark task per file; executor assignment by Spark's
+scheduler — SURVEY.md §2 parallelism table): shards are assigned to HOSTS
+deterministically, hosts feed their local devices, and the mesh's 'data' axis
+carries the global batch. A 'model' axis is supported so consumers can lay
+tensor-parallel computation over the same mesh without re-ingesting.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_tfrecord.io.paths import Shard
+
+
+def create_mesh(
+    axes: Optional[Dict[str, int]] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    """Create a Mesh from named axis sizes; one size may be -1 (inferred).
+
+    Default: all devices on a single 'data' axis.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    axes = dict(axes or {"data": n})
+    unknown = [k for k, v in axes.items() if v == -1]
+    if len(unknown) > 1:
+        raise ValueError("at most one axis size may be -1")
+    known = math.prod(v for v in axes.values() if v != -1)
+    if unknown:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        axes[unknown[0]] = n // known
+    if math.prod(axes.values()) != n:
+        raise ValueError(f"mesh {axes} does not cover {n} devices")
+    dev_array = np.asarray(devices).reshape(tuple(axes.values()))
+    return Mesh(dev_array, tuple(axes.keys()))
+
+
+def data_sharding(mesh: Mesh, axis: str = "data", ndim: int = 1) -> NamedSharding:
+    """NamedSharding placing dim 0 on the data axis, rest replicated."""
+    spec = [axis] + [None] * (ndim - 1)
+    return NamedSharding(mesh, P(*spec))
+
+
+def local_batch_size(global_batch: int, mesh: Mesh, axis: str = "data") -> int:
+    """Per-process batch size for a global batch sharded on ``axis``."""
+    axis_size = mesh.shape[axis]
+    if global_batch % axis_size:
+        raise ValueError(f"global batch {global_batch} not divisible by {axis_size}")
+    pc = jax.process_count()
+    if global_batch % pc:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by process count {pc}"
+        )
+    return global_batch // pc
+
+
+def assign_shards(
+    shards: Sequence[Shard],
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> List[Shard]:
+    """Deterministic interleaved per-host shard assignment.
+
+    Every host computes the same global order (discover_shards sorts), then
+    takes shards ``i`` with ``i % process_count == process_index`` — the
+    analog of Spark's task placement, but static and reproducible so
+    checkpoint/resume and multi-host runs agree without coordination.
+    """
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    return [sh for i, sh in enumerate(shards) if i % pc == pi]
