@@ -1,0 +1,753 @@
+// TFRecord native fast path: CRC32C, frame scan, batch Example decode.
+//
+// Re-implements natively the two components the reference ships as shaded
+// JVM libraries (SURVEY.md §2.8 tensorflow-hadoop wire codec, §2.9 protobuf
+// runtime), fused: one pass over an in-memory shard buffer produces columnar
+// output buffers ready to wrap as numpy arrays. Exposed as a plain C ABI and
+// driven from Python via ctypes (no pybind11 in the image); ctypes releases
+// the GIL for the duration of each call, so decode overlaps Python-side work
+// and device transfers.
+//
+// Layouts match tpu_tfrecord.columnar.Column exactly:
+//   scalar : values[N]                        + mask[N]
+//   ragged : values[total] + row_offsets[N+1] + mask[N]
+//   ragged2: values[total] + inner_offsets[M+1] + row_offsets[N+1] + mask[N]
+//   bytes-like columns use blob + blob_offsets (value boundaries) instead of
+//   a typed values buffer.
+//
+// Build: g++ -std=c++20 -O3 -fPIC -shared [-msse4.2] tfrecord_native.cc
+
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+#if defined(__SSE4_2__)
+#include <nmmintrin.h>
+#endif
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// CRC32C
+// ---------------------------------------------------------------------------
+
+uint32_t crc32c_table[8][256];
+bool crc32c_table_init_done = false;
+
+void init_crc32c_table() {
+  if (crc32c_table_init_done) return;
+  const uint32_t poly = 0x82F63B78u;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t crc = i;
+    for (int j = 0; j < 8; j++) crc = (crc >> 1) ^ ((crc & 1) ? poly : 0);
+    crc32c_table[0][i] = crc;
+  }
+  for (int k = 1; k < 8; k++)
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = crc32c_table[k - 1][i];
+      crc32c_table[k][i] = (c >> 8) ^ crc32c_table[0][c & 0xFF];
+    }
+  crc32c_table_init_done = true;
+}
+
+uint32_t crc32c_sw(const uint8_t* p, uint64_t n, uint32_t crc) {
+  crc ^= 0xFFFFFFFFu;
+  while (n >= 8) {
+    uint64_t w;
+    std::memcpy(&w, p, 8);
+    w ^= crc;  // little-endian
+    crc = crc32c_table[7][w & 0xFF] ^ crc32c_table[6][(w >> 8) & 0xFF] ^
+          crc32c_table[5][(w >> 16) & 0xFF] ^ crc32c_table[4][(w >> 24) & 0xFF] ^
+          crc32c_table[3][(w >> 32) & 0xFF] ^ crc32c_table[2][(w >> 40) & 0xFF] ^
+          crc32c_table[1][(w >> 48) & 0xFF] ^ crc32c_table[0][(w >> 56) & 0xFF];
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc = (crc >> 8) ^ crc32c_table[0][(crc ^ *p++) & 0xFF];
+  return crc ^ 0xFFFFFFFFu;
+}
+
+uint32_t crc32c_impl(const uint8_t* p, uint64_t n, uint32_t crc) {
+#if defined(__SSE4_2__)
+  crc ^= 0xFFFFFFFFu;
+  while (n >= 8) {
+    uint64_t w;
+    std::memcpy(&w, p, 8);
+    crc = (uint32_t)_mm_crc32_u64(crc, w);
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc = _mm_crc32_u8(crc, *p++);
+  return crc ^ 0xFFFFFFFFu;
+#else
+  return crc32c_sw(p, n, crc);
+#endif
+}
+
+inline uint32_t masked_crc(const uint8_t* p, uint64_t n) {
+  uint32_t c = crc32c_impl(p, n, 0);
+  return ((c >> 15) | (c << 17)) + 0xA282EAD8u;
+}
+
+// ---------------------------------------------------------------------------
+// Protobuf wire primitives
+// ---------------------------------------------------------------------------
+
+struct Cursor {
+  const uint8_t* p;
+  const uint8_t* end;
+};
+
+inline bool read_varint(Cursor& c, uint64_t* out) {
+  uint64_t result = 0;
+  int shift = 0;
+  while (c.p < c.end) {
+    uint8_t b = *c.p++;
+    result |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = result;
+      return true;
+    }
+    shift += 7;
+    if (shift > 63) return false;
+  }
+  return false;
+}
+
+inline bool skip_field(Cursor& c, uint32_t wire_type) {
+  uint64_t tmp;
+  switch (wire_type) {
+    case 0: return read_varint(c, &tmp);
+    case 1: if (c.end - c.p < 8) return false; c.p += 8; return true;
+    case 2:
+      if (!read_varint(c, &tmp) || (uint64_t)(c.end - c.p) < tmp) return false;
+      c.p += tmp;
+      return true;
+    case 5: if (c.end - c.p < 4) return false; c.p += 4; return true;
+    default: return false;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Column builders
+// ---------------------------------------------------------------------------
+
+constexpr int32_t KIND_BYTES = 1, KIND_FLOAT = 2, KIND_INT64 = 3;
+constexpr int32_t LAYOUT_SCALAR = 0, LAYOUT_RAGGED = 1, LAYOUT_RAGGED2 = 2;
+constexpr int32_t DT_I64 = 0, DT_I32 = 1, DT_F32 = 2, DT_F64 = 3, DT_BYTES = -1;
+
+struct ColBuilder {
+  int32_t layout = LAYOUT_SCALAR;
+  int32_t kind = KIND_INT64;
+  int32_t dtype = DT_I64;
+  bool nullable = true;
+  std::string name;
+
+  std::vector<int64_t> i64;
+  std::vector<int32_t> i32;
+  std::vector<float> f32;
+  std::vector<double> f64;
+  std::vector<uint8_t> blob;
+  std::vector<int64_t> blob_offsets;  // value boundaries in blob
+  std::vector<int64_t> row_offsets;   // per-row value (or inner-list) counts
+  std::vector<int64_t> inner_offsets; // ragged2 only
+  std::vector<uint8_t> mask;
+
+  int64_t value_count = 0;   // running for row_offsets
+  int64_t inner_count = 0;   // running for ragged2 inner lists
+
+  void init_offsets() {
+    row_offsets.push_back(0);
+    if (layout == LAYOUT_RAGGED2) inner_offsets.push_back(0);
+    if (dtype == DT_BYTES) blob_offsets.push_back(0);
+  }
+
+  inline void push_i64(int64_t v) {
+    if (dtype == DT_I64) i64.push_back(v);
+    else i32.push_back((int32_t)v);  // Scala Long.toInt truncation semantics
+  }
+  inline void push_f32(float v) {
+    if (dtype == DT_F32) f32.push_back(v);
+    else f64.push_back((double)v);
+  }
+  inline void push_bytes(const uint8_t* p, uint64_t n) {
+    blob.insert(blob.end(), p, p + n);
+    blob_offsets.push_back((int64_t)blob.size());
+  }
+
+  // Undo the current record's (single) contribution to this column — the
+  // last mask entry plus whatever values/offsets it appended. Everything is
+  // derivable from the buffer tails, so duplicate-key last-wins semantics
+  // cost nothing on the happy path.
+  void rollback() {
+    if (mask.empty()) return;
+    mask.pop_back();
+    if (layout == LAYOUT_SCALAR) {
+      if (dtype == DT_BYTES) {
+        blob_offsets.pop_back();
+        blob.resize((size_t)blob_offsets.back());
+      } else {
+        switch (dtype) {
+          case DT_I64: i64.pop_back(); break;
+          case DT_I32: i32.pop_back(); break;
+          case DT_F32: f32.pop_back(); break;
+          case DT_F64: f64.pop_back(); break;
+        }
+      }
+      return;
+    }
+    row_offsets.pop_back();
+    int64_t prev = row_offsets.back();
+    if (layout == LAYOUT_RAGGED) {
+      value_count = prev;
+      if (dtype == DT_BYTES) {
+        blob_offsets.resize((size_t)prev + 1);
+        blob.resize((size_t)blob_offsets.back());
+      } else {
+        switch (dtype) {
+          case DT_I64: i64.resize((size_t)prev); break;
+          case DT_I32: i32.resize((size_t)prev); break;
+          case DT_F32: f32.resize((size_t)prev); break;
+          case DT_F64: f64.resize((size_t)prev); break;
+        }
+      }
+    } else {  // RAGGED2: row_offsets index inner lists
+      value_count = prev;
+      inner_offsets.resize((size_t)prev + 1);
+      inner_count = inner_offsets.back();
+      if (dtype == DT_BYTES) {
+        blob_offsets.resize((size_t)inner_count + 1);
+        blob.resize((size_t)blob_offsets.back());
+      } else {
+        switch (dtype) {
+          case DT_I64: i64.resize((size_t)inner_count); break;
+          case DT_I32: i32.resize((size_t)inner_count); break;
+          case DT_F32: f32.resize((size_t)inner_count); break;
+          case DT_F64: f64.resize((size_t)inner_count); break;
+        }
+      }
+    }
+  }
+};
+
+struct BatchResult {
+  std::vector<ColBuilder> cols;
+  std::string error;
+};
+
+struct string_hash {
+  using is_transparent = void;
+  size_t operator()(std::string_view sv) const { return std::hash<std::string_view>{}(sv); }
+  size_t operator()(const std::string& s) const { return std::hash<std::string_view>{}(s); }
+};
+
+using FieldMap = std::unordered_map<std::string, int, string_hash, std::equal_to<>>;
+
+// Records from one writer almost always carry their feature-map entries in
+// the same key order. Remember the order seen in the first record and match
+// subsequent records' keys by position with a single memcmp — a hit skips
+// the hash lookup entirely (including for keys NOT in the schema).
+struct StickyOrder {
+  std::vector<std::pair<std::string, int>> order;  // key -> field idx (-1: skip)
+  size_t cursor = 0;
+  bool building = true;
+
+  inline int lookup(std::string_view key, const FieldMap& fields) {
+    if (cursor < order.size()) {
+      const auto& e = order[cursor];
+      if (e.first.size() == key.size() &&
+          std::memcmp(e.first.data(), key.data(), key.size()) == 0) {
+        cursor++;
+        return e.second;
+      }
+    }
+    auto it = fields.find(key);
+    int idx = it == fields.end() ? -1 : it->second;
+    if (building) {
+      order.emplace_back(std::string(key), idx);
+      cursor = order.size();
+    } else {
+      cursor = order.size();  // out of sync for the rest of this record
+    }
+    return idx;
+  }
+
+  inline void next_record() {
+    building = false;
+    cursor = 0;
+  }
+};
+
+// Parse one Feature submessage's values into col. element_cap: for scalar
+// columns only the first value is kept but extra values are legal (head
+// semantics of the reference deserializer). Returns value count, or -1 on
+// kind mismatch / parse error (err set).
+int64_t parse_feature_values(const uint8_t* fp, const uint8_t* fend,
+                             ColBuilder& col, bool scalar, std::string& err) {
+  Cursor c{fp, fend};
+  int64_t count = 0;
+  bool kind_seen = false;
+  while (c.p < c.end) {
+    uint64_t tag;
+    if (!read_varint(c, &tag)) { err = "truncated feature tag"; return -1; }
+    uint32_t fnum = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+    if ((int32_t)fnum != col.kind || wt != 2) {
+      if (fnum >= 1 && fnum <= 3 && wt == 2) {
+        err = "column " + col.name + ": feature kind does not match schema type";
+        return -1;
+      }
+      if (!skip_field(c, wt)) { err = "bad field in feature"; return -1; }
+      continue;
+    }
+    kind_seen = true;
+    uint64_t len;
+    if (!read_varint(c, &len) || (uint64_t)(c.end - c.p) < len) {
+      err = "truncated list"; return -1;
+    }
+    Cursor lc{c.p, c.p + len};
+    c.p += len;
+    // Inside BytesList/FloatList/Int64List: field 1 values.
+    while (lc.p < lc.end) {
+      uint64_t ltag;
+      if (!read_varint(lc, &ltag)) { err = "truncated list tag"; return -1; }
+      uint32_t lnum = (uint32_t)(ltag >> 3), lwt = (uint32_t)(ltag & 7);
+      if (lnum != 1) { if (!skip_field(lc, lwt)) { err = "bad list field"; return -1; } continue; }
+      if (col.kind == KIND_INT64) {
+        if (lwt == 2) {  // packed varints
+          uint64_t plen;
+          if (!read_varint(lc, &plen) || (uint64_t)(lc.end - lc.p) < plen) { err = "truncated packed"; return -1; }
+          Cursor pc{lc.p, lc.p + plen};
+          lc.p += plen;
+          while (pc.p < pc.end) {
+            uint64_t v;
+            if (!read_varint(pc, &v)) { err = "truncated varint"; return -1; }
+            if (!scalar || count == 0) col.push_i64((int64_t)v);
+            count++;
+          }
+        } else if (lwt == 0) {
+          uint64_t v;
+          if (!read_varint(lc, &v)) { err = "truncated varint"; return -1; }
+          if (!scalar || count == 0) col.push_i64((int64_t)v);
+          count++;
+        } else { if (!skip_field(lc, lwt)) { err = "bad int64 enc"; return -1; } }
+      } else if (col.kind == KIND_FLOAT) {
+        if (lwt == 2) {  // packed floats
+          uint64_t plen;
+          if (!read_varint(lc, &plen) || (uint64_t)(lc.end - lc.p) < plen || plen % 4) { err = "bad packed floats"; return -1; }
+          uint64_t n = plen / 4;
+          for (uint64_t i = 0; i < n; i++) {
+            float v;
+            std::memcpy(&v, lc.p + 4 * i, 4);
+            if (!scalar || count == 0) col.push_f32(v);
+            count++;
+          }
+          lc.p += plen;
+        } else if (lwt == 5) {
+          float v;
+          if (lc.end - lc.p < 4) { err = "truncated float"; return -1; }
+          std::memcpy(&v, lc.p, 4);
+          lc.p += 4;
+          if (!scalar || count == 0) col.push_f32(v);
+          count++;
+        } else { if (!skip_field(lc, lwt)) { err = "bad float enc"; return -1; } }
+      } else {  // KIND_BYTES
+        if (lwt != 2) { if (!skip_field(lc, lwt)) { err = "bad bytes enc"; return -1; } continue; }
+        uint64_t blen;
+        if (!read_varint(lc, &blen) || (uint64_t)(lc.end - lc.p) < blen) { err = "truncated bytes"; return -1; }
+        if (!scalar || count == 0) col.push_bytes(lc.p, blen);
+        lc.p += blen;
+        count++;
+      }
+    }
+  }
+  if (!kind_seen) return -2;  // kind oneof unset -> treated as missing
+  return count;
+}
+
+// Decode one Features map region (Example.features or SequenceExample.context)
+bool parse_features_map(const uint8_t* p, const uint8_t* end, const FieldMap& fields,
+                        StickyOrder& sticky,
+                        std::vector<ColBuilder>& cols, std::vector<int32_t>& seen_epoch,
+                        int32_t epoch, std::string& err) {
+  Cursor c{p, end};
+  while (c.p < c.end) {
+    uint64_t tag;
+    if (!read_varint(c, &tag)) { err = "truncated features tag"; return false; }
+    if ((tag >> 3) != 1 || (tag & 7) != 2) { if (!skip_field(c, (uint32_t)(tag & 7))) { err = "bad features field"; return false; } continue; }
+    uint64_t elen;
+    if (!read_varint(c, &elen) || (uint64_t)(c.end - c.p) < elen) { err = "truncated map entry"; return false; }
+    Cursor ec{c.p, c.p + elen};
+    c.p += elen;
+    std::string_view key;
+    const uint8_t* fstart = nullptr;
+    const uint8_t* fend = nullptr;
+    while (ec.p < ec.end) {
+      uint64_t etag;
+      if (!read_varint(ec, &etag)) { err = "truncated entry tag"; return false; }
+      uint32_t enum_ = (uint32_t)(etag >> 3), ewt = (uint32_t)(etag & 7);
+      if (enum_ == 1 && ewt == 2) {
+        uint64_t klen;
+        if (!read_varint(ec, &klen) || (uint64_t)(ec.end - ec.p) < klen) { err = "truncated key"; return false; }
+        key = std::string_view((const char*)ec.p, klen);
+        ec.p += klen;
+      } else if (enum_ == 2 && ewt == 2) {
+        uint64_t flen;
+        if (!read_varint(ec, &flen) || (uint64_t)(ec.end - ec.p) < flen) { err = "truncated feature"; return false; }
+        fstart = ec.p;
+        fend = ec.p + flen;
+        ec.p += flen;
+      } else {
+        if (!skip_field(ec, ewt)) { err = "bad entry field"; return false; }
+      }
+    }
+    if (key.empty() && fstart == nullptr) continue;
+    int idx = sticky.lookup(key, fields);
+    if (idx < 0) continue;  // column pruning: skip cheap
+    ColBuilder& col = cols[idx];
+    if (col.layout == LAYOUT_RAGGED2) {
+      err = "column " + col.name + ": flat feature for array-of-array type";
+      return false;
+    }
+    if (seen_epoch[idx] == epoch) {
+      // Duplicate map key in one record: protobuf map semantics are
+      // last-wins (matching the Python path) — roll back the previous
+      // occurrence's contribution, then re-append.
+      col.rollback();
+      seen_epoch[idx] = -1;  // unseen again until the re-append succeeds
+    }
+    bool scalar = col.layout == LAYOUT_SCALAR;
+    int64_t n = fstart ? parse_feature_values(fstart, fend, col, scalar, err)
+                       : -2;
+    if (n == -1) return false;
+    if (n == -2) continue;  // unset oneof -> missing
+    seen_epoch[idx] = epoch;
+    if (scalar) {
+      if (n == 0) {
+        if (col.kind == KIND_BYTES) {
+          // Empty BytesList scalar decodes as b"" (Python oracle parity).
+          col.blob_offsets.push_back((int64_t)col.blob.size());
+        } else {
+          err = "column " + col.name + ": empty feature for scalar";
+          return false;
+        }
+      }
+      col.mask.push_back(1);
+    } else {
+      col.value_count += n;
+      col.row_offsets.push_back(col.value_count);
+      col.mask.push_back(1);
+    }
+  }
+  return true;
+}
+
+bool parse_feature_lists(const uint8_t* p, const uint8_t* end, const FieldMap& fields,
+                         StickyOrder& sticky,
+                         std::vector<ColBuilder>& cols, std::vector<int32_t>& seen_epoch,
+                         int32_t epoch, std::string& err) {
+  Cursor c{p, end};
+  while (c.p < c.end) {
+    uint64_t tag;
+    if (!read_varint(c, &tag)) { err = "truncated featurelists tag"; return false; }
+    if ((tag >> 3) != 1 || (tag & 7) != 2) { if (!skip_field(c, (uint32_t)(tag & 7))) { err = "bad featurelists field"; return false; } continue; }
+    uint64_t elen;
+    if (!read_varint(c, &elen) || (uint64_t)(c.end - c.p) < elen) { err = "truncated fl entry"; return false; }
+    Cursor ec{c.p, c.p + elen};
+    c.p += elen;
+    std::string_view key;
+    const uint8_t* lstart = nullptr;
+    const uint8_t* lend = nullptr;
+    while (ec.p < ec.end) {
+      uint64_t etag;
+      if (!read_varint(ec, &etag)) { err = "truncated fl entry tag"; return false; }
+      uint32_t enum_ = (uint32_t)(etag >> 3), ewt = (uint32_t)(etag & 7);
+      if (enum_ == 1 && ewt == 2) {
+        uint64_t klen;
+        if (!read_varint(ec, &klen) || (uint64_t)(ec.end - ec.p) < klen) { err = "truncated fl key"; return false; }
+        key = std::string_view((const char*)ec.p, klen);
+        ec.p += klen;
+      } else if (enum_ == 2 && ewt == 2) {
+        uint64_t flen;
+        if (!read_varint(ec, &flen) || (uint64_t)(ec.end - ec.p) < flen) { err = "truncated featurelist"; return false; }
+        lstart = ec.p;
+        lend = ec.p + flen;
+        ec.p += flen;
+      } else {
+        if (!skip_field(ec, ewt)) { err = "bad fl entry field"; return false; }
+      }
+    }
+    int idx = sticky.lookup(key, fields);
+    if (idx < 0) continue;
+    ColBuilder& col = cols[idx];
+    if (seen_epoch[idx] == epoch) continue;
+    // iterate FeatureList { repeated Feature feature = 1; }
+    int64_t n_inner = 0;
+    Cursor lc{lstart ? lstart : end, lend ? lend : end};
+    while (lc.p < lc.end) {
+      uint64_t ltag;
+      if (!read_varint(lc, &ltag)) { err = "truncated fl tag"; return false; }
+      if ((ltag >> 3) != 1 || (ltag & 7) != 2) { if (!skip_field(lc, (uint32_t)(ltag & 7))) { err = "bad fl field"; return false; } continue; }
+      uint64_t flen;
+      if (!read_varint(lc, &flen) || (uint64_t)(lc.end - lc.p) < flen) { err = "truncated inner feature"; return false; }
+      const uint8_t* fs = lc.p;
+      const uint8_t* fe = lc.p + flen;
+      lc.p += flen;
+      if (col.layout == LAYOUT_RAGGED2) {
+        int64_t n = parse_feature_values(fs, fe, col, false, err);
+        if (n == -1) return false;
+        if (n == -2) n = 0;
+        col.inner_count += n;
+        col.inner_offsets.push_back(col.inner_count);
+        n_inner++;
+      } else if (col.layout == LAYOUT_RAGGED) {
+        // FeatureList of scalar features: one value per inner feature
+        int64_t n = parse_feature_values(fs, fe, col, true, err);
+        if (n == -1) return false;
+        if (n == 0 || n == -2) { err = "column " + col.name + ": empty inner feature"; return false; }
+        n_inner++;
+      } else {
+        err = "column " + col.name + ": FeatureList for scalar type";
+        return false;
+      }
+    }
+    seen_epoch[idx] = epoch;
+    if (col.layout == LAYOUT_RAGGED2) {
+      col.value_count += n_inner;       // rows index inner lists
+      col.row_offsets.push_back(col.value_count);
+    } else {
+      col.value_count += n_inner;
+      col.row_offsets.push_back(col.value_count);
+    }
+    col.mask.push_back(1);
+  }
+  return true;
+}
+
+void append_missing(ColBuilder& col) {
+  col.mask.push_back(0);
+  if (col.layout == LAYOUT_SCALAR) {
+    switch (col.dtype) {
+      case DT_I64: col.i64.push_back(0); break;
+      case DT_I32: col.i32.push_back(0); break;
+      case DT_F32: col.f32.push_back(0.f); break;
+      case DT_F64: col.f64.push_back(0.0); break;
+      case DT_BYTES: col.blob_offsets.push_back((int64_t)col.blob.size()); break;
+    }
+  } else {
+    col.row_offsets.push_back(col.value_count);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+uint32_t tfr_crc32c(const uint8_t* data, uint64_t len) {
+  init_crc32c_table();
+  return crc32c_impl(data, len, 0);
+}
+
+// Scan TFRecord framing. Fills offsets/lengths (payload spans). Returns
+// record count, or -1 (corrupt length crc), -2 (truncated), -3 (bad data
+// crc), -4 (capacity exceeded).
+int64_t tfr_scan(const uint8_t* buf, uint64_t len, int32_t verify,
+                 uint64_t* offsets, uint64_t* lengths, int64_t cap) {
+  init_crc32c_table();
+  uint64_t pos = 0;
+  int64_t n = 0;
+  while (pos < len) {
+    if (pos + 12 > len) return -2;
+    uint64_t rec_len;
+    std::memcpy(&rec_len, buf + pos, 8);
+    uint32_t len_crc;
+    std::memcpy(&len_crc, buf + pos + 8, 4);
+    if (verify && masked_crc(buf + pos, 8) != len_crc) return -1;
+    uint64_t start = pos + 12;
+    // Overflow-safe bounds check: a corrupt 8-byte length near UINT64_MAX
+    // must not wrap `start + rec_len + 4` back below `len`.
+    if (len - start < 4 || rec_len > len - start - 4) return -2;
+    if (verify) {
+      uint32_t data_crc;
+      std::memcpy(&data_crc, buf + start + rec_len, 4);
+      if (masked_crc(buf + start, rec_len) != data_crc) return -3;
+    }
+    if (n >= cap) return -4;
+    offsets[n] = start;
+    lengths[n] = rec_len;
+    n++;
+    pos = start + rec_len + 4;
+  }
+  return n;
+}
+
+// Batch decode. record_format: 0 = Example, 1 = SequenceExample.
+// Returns an opaque handle (free with tfr_result_free) or nullptr with
+// errbuf filled.
+void* tfr_decode_batch(const uint8_t* buf,
+                       const uint64_t* rec_offsets, const uint64_t* rec_lengths,
+                       int64_t n_records, int32_t record_format,
+                       int32_t n_fields, const char** field_names,
+                       const int32_t* layouts, const int32_t* kinds,
+                       const int32_t* dtypes, const uint8_t* nullables,
+                       char* errbuf, int64_t errbuf_len) {
+  auto* res = new BatchResult();
+  res->cols.resize(n_fields);
+  FieldMap fields;
+  for (int32_t i = 0; i < n_fields; i++) {
+    ColBuilder& col = res->cols[i];
+    col.name = field_names[i];
+    col.layout = layouts[i];
+    col.kind = kinds[i];
+    col.dtype = dtypes[i];
+    col.nullable = nullables[i] != 0;
+    col.init_offsets();
+    fields.emplace(col.name, i);
+    // Pre-size the common buffers for the batch.
+    col.mask.reserve(n_records);
+    if (col.layout != LAYOUT_SCALAR) col.row_offsets.reserve(n_records + 1);
+    if (col.dtype == DT_BYTES) {
+      col.blob_offsets.reserve(n_records + 1);
+      col.blob.reserve((size_t)n_records * 8);
+    } else if (col.layout == LAYOUT_SCALAR) {
+      switch (col.dtype) {
+        case DT_I64: col.i64.reserve(n_records); break;
+        case DT_I32: col.i32.reserve(n_records); break;
+        case DT_F32: col.f32.reserve(n_records); break;
+        case DT_F64: col.f64.reserve(n_records); break;
+      }
+    }
+  }
+  std::vector<int32_t> seen_epoch(n_fields, -1);
+  StickyOrder sticky_features, sticky_lists;
+  std::string err;
+
+  for (int64_t r = 0; r < n_records; r++) {
+    if (r) { sticky_features.next_record(); sticky_lists.next_record(); }
+    Cursor c{buf + rec_offsets[r], buf + rec_offsets[r] + rec_lengths[r]};
+    bool ok = true;
+    while (c.p < c.end && ok) {
+      uint64_t tag;
+      if (!read_varint(c, &tag)) { err = "truncated record tag"; ok = false; break; }
+      uint32_t fnum = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+      if (wt == 2 && ((record_format == 0 && fnum == 1) ||
+                      (record_format == 1 && (fnum == 1 || fnum == 2)))) {
+        uint64_t mlen;
+        if (!read_varint(c, &mlen) || (uint64_t)(c.end - c.p) < mlen) { err = "truncated message"; ok = false; break; }
+        const uint8_t* ms = c.p;
+        const uint8_t* me = c.p + mlen;
+        c.p += mlen;
+        if (record_format == 1 && fnum == 2) {
+          ok = parse_feature_lists(ms, me, fields, sticky_lists, res->cols, seen_epoch, (int32_t)r, err);
+        } else {
+          ok = parse_features_map(ms, me, fields, sticky_features, res->cols, seen_epoch, (int32_t)r, err);
+        }
+      } else {
+        if (!skip_field(c, wt)) { err = "bad record field"; ok = false; }
+      }
+    }
+    if (ok) {
+      for (int32_t i = 0; i < n_fields; i++) {
+        if (seen_epoch[i] != (int32_t)r) {
+          if (!res->cols[i].nullable) {
+            err = "Field " + res->cols[i].name + " does not allow null values";
+            ok = false;
+            break;
+          }
+          append_missing(res->cols[i]);
+        }
+      }
+    }
+    if (!ok) {
+      std::snprintf(errbuf, errbuf_len, "record %lld: %s", (long long)r, err.c_str());
+      delete res;
+      return nullptr;
+    }
+  }
+  return res;
+}
+
+static ColBuilder* get_col(void* h, int32_t i) {
+  return &static_cast<BatchResult*>(h)->cols[i];
+}
+
+int64_t tfr_result_values(void* h, int32_t i, const void** ptr) {
+  ColBuilder* c = get_col(h, i);
+  switch (c->dtype) {
+    case DT_I64: *ptr = c->i64.data(); return (int64_t)c->i64.size() * 8;
+    case DT_I32: *ptr = c->i32.data(); return (int64_t)c->i32.size() * 4;
+    case DT_F32: *ptr = c->f32.data(); return (int64_t)c->f32.size() * 4;
+    case DT_F64: *ptr = c->f64.data(); return (int64_t)c->f64.size() * 8;
+    default: *ptr = nullptr; return 0;
+  }
+}
+
+int64_t tfr_result_row_offsets(void* h, int32_t i, const int64_t** ptr) {
+  ColBuilder* c = get_col(h, i);
+  *ptr = c->row_offsets.data();
+  return (int64_t)c->row_offsets.size();
+}
+
+int64_t tfr_result_inner_offsets(void* h, int32_t i, const int64_t** ptr) {
+  ColBuilder* c = get_col(h, i);
+  *ptr = c->inner_offsets.data();
+  return (int64_t)c->inner_offsets.size();
+}
+
+int64_t tfr_result_blob(void* h, int32_t i, const uint8_t** ptr) {
+  ColBuilder* c = get_col(h, i);
+  *ptr = c->blob.data();
+  return (int64_t)c->blob.size();
+}
+
+int64_t tfr_result_blob_offsets(void* h, int32_t i, const int64_t** ptr) {
+  ColBuilder* c = get_col(h, i);
+  *ptr = c->blob_offsets.data();
+  return (int64_t)c->blob_offsets.size();
+}
+
+int64_t tfr_result_mask(void* h, int32_t i, const uint8_t** ptr) {
+  ColBuilder* c = get_col(h, i);
+  *ptr = c->mask.data();
+  return (int64_t)c->mask.size();
+}
+
+void tfr_result_free(void* h) { delete static_cast<BatchResult*>(h); }
+
+// Frame + write helpers: frame records into an output buffer.
+// Returns bytes written or -1 if out_cap too small.
+int64_t tfr_frame_records(const uint8_t* payloads, const uint64_t* offsets,
+                          const uint64_t* lengths, int64_t n,
+                          uint8_t* out, int64_t out_cap) {
+  init_crc32c_table();
+  uint64_t pos = 0;
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t len = lengths[i];
+    if ((int64_t)(pos + 16 + len) > out_cap) return -1;
+    std::memcpy(out + pos, &len, 8);
+    uint32_t lcrc = masked_crc(out + pos, 8);
+    std::memcpy(out + pos + 8, &lcrc, 4);
+    std::memcpy(out + pos + 12, payloads + offsets[i], len);
+    uint32_t dcrc = masked_crc(out + pos + 12, len);
+    std::memcpy(out + pos + 12 + len, &dcrc, 4);
+    pos += 16 + len;
+  }
+  return (int64_t)pos;
+}
+
+// CRC32C-hash each value in a blob into [0, num_buckets). The categorical
+// string -> embedding-row path: strings never reach Python objects or the
+// TPU; one call hashes a whole column.
+void tfr_hash_blob(const uint8_t* blob, const int64_t* offsets, int64_t n,
+                   int64_t num_buckets, int64_t* out) {
+  init_crc32c_table();
+  for (int64_t i = 0; i < n; i++) {
+    uint32_t c = crc32c_impl(blob + offsets[i], (uint64_t)(offsets[i + 1] - offsets[i]), 0);
+    out[i] = (int64_t)(c % (uint64_t)num_buckets);
+  }
+}
+
+}  // extern "C"

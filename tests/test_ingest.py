@@ -88,7 +88,7 @@ class TestHostBatch:
         assert hb["emb"].shape == (8, 4)
         np.testing.assert_allclose(hb["emb"][0], [0.0, 1.0, 2.0, 0.0])
         np.testing.assert_array_equal(hb["emb_len"], [3] * 8)
-        assert hb["cat"].dtype == np.int64
+        assert hb["cat"].dtype == np.int32
         assert (hb["cat"] < 16).all() and (hb["cat"] >= 0).all()
 
     def test_hashing_is_deterministic(self):

@@ -1,0 +1,272 @@
+"""Tests for the C++ fast path: CRC32C, frame scan, batch decode — each
+checked against the pure-Python implementation as the correctness oracle."""
+
+import numpy as np
+import pytest
+
+from tpu_tfrecord import _native, wire
+from tpu_tfrecord.columnar import ColumnarDecoder
+from tpu_tfrecord.options import RecordType
+from tpu_tfrecord.proto import (
+    Example,
+    Feature,
+    FeatureList,
+    SequenceExample,
+    encode_example,
+    encode_sequence_example,
+)
+from tpu_tfrecord.schema import (
+    ArrayType,
+    BinaryType,
+    DoubleType,
+    FloatType,
+    IntegerType,
+    LongType,
+    StringType,
+    StructField,
+    StructType,
+)
+from tpu_tfrecord.serde import NullValueError
+
+pytestmark = pytest.mark.skipif(
+    not _native.available(), reason=f"native lib unavailable: {_native.load_error()}"
+)
+
+
+class TestCrc32c:
+    def test_matches_python(self):
+        for data in [b"", b"123456789", b"\x00" * 32, bytes(range(256)) * 7]:
+            assert _native.crc32c(data) == wire.crc32c_py(data)
+
+    def test_check_value(self):
+        assert _native.crc32c(b"123456789") == 0xE3069283
+
+
+class TestScan:
+    def test_matches_python_scan(self):
+        records = [b"a", b"bb" * 100, b"", b"xyz"]
+        buf = b"".join(wire.encode_record(r) for r in records)
+        offsets, lengths = _native.scan(buf)
+        got = [buf[o : o + l] for o, l in zip(offsets.tolist(), lengths.tolist())]
+        assert got == records
+
+    def test_detects_corruption(self):
+        buf = bytearray(wire.encode_record(b"payload"))
+        buf[13] ^= 0x55
+        with pytest.raises(wire.TFRecordCorruptionError):
+            _native.scan(bytes(buf))
+        # without verification it scans fine
+        offsets, lengths = _native.scan(bytes(buf), verify_crc=False)
+        assert len(offsets) == 1
+
+    def test_detects_truncation(self):
+        buf = wire.encode_record(b"payload")[:-2]
+        with pytest.raises(wire.TFRecordCorruptionError):
+            _native.scan(buf)
+
+
+SCHEMA = StructType(
+    [
+        StructField("i", IntegerType()),
+        StructField("l", LongType()),
+        StructField("f", FloatType()),
+        StructField("d", DoubleType()),
+        StructField("s", StringType()),
+        StructField("b", BinaryType()),
+        StructField("fv", ArrayType(FloatType())),
+        StructField("lv", ArrayType(LongType())),
+        StructField("sv", ArrayType(StringType())),
+    ]
+)
+
+
+def make_records(n=50, seed=0):
+    rng = np.random.default_rng(seed)
+    records = []
+    for k in range(n):
+        feats = {}
+        if k % 7 != 3:  # some rows miss some features
+            feats["i"] = Feature.int64_list([int(rng.integers(-(2**33), 2**33))])
+            feats["l"] = Feature.int64_list([int(rng.integers(-(2**62), 2**62))])
+        feats["f"] = Feature.float_list([float(rng.normal())])
+        feats["d"] = Feature.float_list([float(rng.normal())])
+        feats["s"] = Feature.bytes_list([f"str-{k}-é".encode("utf-8")])
+        feats["b"] = Feature.bytes_list([bytes(rng.integers(0, 256, size=k % 5, dtype=np.uint8))])
+        feats["fv"] = Feature.float_list(rng.normal(size=k % 4).tolist())
+        feats["lv"] = Feature.int64_list(rng.integers(0, 100, size=(k * 3) % 7).tolist())
+        feats["sv"] = Feature.bytes_list([f"t{j}".encode() for j in range(k % 3)])
+        feats["extra_unrequested"] = Feature.int64_list([1, 2, 3])
+        records.append(encode_example(Example(features=feats)))
+    return records
+
+
+def assert_batches_equal(got, want):
+    assert got.num_rows == want.num_rows
+    assert set(got.columns) == set(want.columns)
+    for name in want.columns:
+        g, w = got[name], want[name]
+        np.testing.assert_array_equal(g.mask, w.mask, err_msg=f"{name}.mask")
+        if w.offsets is not None:
+            np.testing.assert_array_equal(g.offsets, w.offsets, err_msg=f"{name}.offsets")
+        if w.inner_offsets is not None:
+            np.testing.assert_array_equal(
+                g.inner_offsets, w.inner_offsets, err_msg=f"{name}.inner_offsets"
+            )
+        if w.values is not None:
+            assert g.values.dtype == w.values.dtype, name
+            np.testing.assert_array_equal(g.values, w.values, err_msg=f"{name}.values")
+        if w.blobs is not None:
+            assert g.blobs == w.blobs, name
+
+
+class TestNativeExampleDecode:
+    def test_matches_python_oracle(self):
+        records = make_records(80)
+        want = ColumnarDecoder(SCHEMA).decode_batch(records)
+        got = _native.NativeDecoder(SCHEMA).decode_batch(records)
+        assert_batches_equal(got, want)
+
+    def test_int32_truncation_matches(self):
+        schema = StructType([StructField("x", IntegerType())])
+        recs = [encode_example(Example(features={"x": Feature.int64_list([2**31 + 10])}))]
+        got = _native.NativeDecoder(schema).decode_batch(recs)
+        want = ColumnarDecoder(schema).decode_batch(recs)
+        assert got["x"].values[0] == want["x"].values[0] == -(2**31) + 10
+
+    def test_missing_non_nullable_raises(self):
+        schema = StructType([StructField("x", LongType(), nullable=False)])
+        with pytest.raises(NullValueError):
+            _native.NativeDecoder(schema).decode_batch([encode_example(Example())])
+
+    def test_kind_mismatch_raises(self):
+        schema = StructType([StructField("x", FloatType())])
+        recs = [encode_example(Example(features={"x": Feature.int64_list([1])}))]
+        with pytest.raises(ValueError, match="kind"):
+            _native.NativeDecoder(schema).decode_batch(recs)
+
+    def test_decode_spans_from_file_buffer(self, sandbox):
+        records = make_records(20)
+        path = str(sandbox / "x.tfrecord")
+        wire.write_records(path, records)
+        buf = open(path, "rb").read()
+        offsets, lengths = _native.scan(buf)
+        got = _native.NativeDecoder(SCHEMA).decode_spans(buf, offsets, lengths)
+        want = ColumnarDecoder(SCHEMA).decode_batch(records)
+        assert_batches_equal(got, want)
+
+
+class TestNativeSequenceExampleDecode:
+    SCHEMA = StructType(
+        [
+            StructField("id", LongType()),
+            StructField("frames", ArrayType(ArrayType(FloatType()))),
+            StructField("toks", ArrayType(LongType())),
+            StructField("names", ArrayType(ArrayType(StringType()))),
+        ]
+    )
+
+    def make(self, n=30):
+        rng = np.random.default_rng(1)
+        out = []
+        for k in range(n):
+            fl = FeatureList(
+                [Feature.float_list(rng.normal(size=int(rng.integers(0, 4))).tolist())
+                 for _ in range(int(rng.integers(0, 3)))]
+            )
+            toks = FeatureList(
+                [Feature.int64_list([int(v)]) for v in rng.integers(0, 9, size=k % 4)]
+            )
+            names = FeatureList(
+                [Feature.bytes_list([f"n{j}".encode() for j in range(int(rng.integers(1, 3)))])
+                 for _ in range(k % 3)]
+            )
+            se = SequenceExample(
+                context={"id": Feature.int64_list([k])},
+                feature_lists={"frames": fl, "toks": toks, "names": names},
+            )
+            out.append(encode_sequence_example(se))
+        return out
+
+    def test_matches_python_oracle(self):
+        records = self.make()
+        want = ColumnarDecoder(self.SCHEMA, RecordType.SEQUENCE_EXAMPLE).decode_batch(records)
+        got = _native.NativeDecoder(self.SCHEMA, RecordType.SEQUENCE_EXAMPLE).decode_batch(records)
+        assert_batches_equal(got, want)
+
+
+class TestFrameRecords:
+    def test_native_framing_matches_python(self):
+        lib = _native.load()
+        records = [b"abc", b"", b"x" * 500]
+        payloads = b"".join(records)
+        lengths = np.array([len(r) for r in records], dtype=np.uint64)
+        offsets = np.zeros(3, dtype=np.uint64)
+        np.cumsum(lengths[:-1], out=offsets[1:])
+        out = np.empty(sum(len(r) + 16 for r in records), dtype=np.uint8)
+        import ctypes
+
+        n = lib.tfr_frame_records(
+            payloads,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            3,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            len(out),
+        )
+        assert n == len(out)
+        want = b"".join(wire.encode_record(r) for r in records)
+        assert out.tobytes() == want
+
+
+class TestReviewRegressions:
+    """Pins for review findings: overflow-safe scan, empty-bytes scalar
+    parity, duplicate-key last-wins parity, scan copy semantics."""
+
+    def test_scan_huge_length_no_oob(self):
+        # 8-byte length near UINT64_MAX must raise, not wrap the bounds check
+        import struct as _s
+
+        evil = _s.pack("<Q", 0xFFFFFFFFFFFFFFF0) + b"\x00" * 8
+        with pytest.raises(wire.TFRecordCorruptionError):
+            _native.scan(evil, verify_crc=False)
+
+    def test_scan_returns_compact_copies(self):
+        buf = wire.encode_record(b"x" * 10_000)
+        offsets, lengths = _native.scan(buf)
+        # must not pin the cap-sized backing array (len(buf)/16 entries)
+        assert offsets.base is None or offsets.base.nbytes <= offsets.nbytes * 2
+
+    def test_empty_bytes_scalar_matches_python(self):
+        schema = StructType([StructField("s", StringType())])
+        recs = [encode_example(Example(features={"s": Feature(1, [])}))]  # empty BytesList
+        want = ColumnarDecoder(schema).decode_batch(recs)
+        got = _native.NativeDecoder(schema).decode_batch(recs)
+        assert want["s"].blobs == [b""] and got["s"].blobs == [b""]
+        np.testing.assert_array_equal(got["s"].mask, want["s"].mask)
+
+    def test_duplicate_map_key_last_wins_both_paths(self):
+        # hand-build an Example whose features map has "x" twice
+        def entry(value_varint):
+            int64_list = bytes([0x0A, 0x01, value_varint])  # field1 packed len1
+            feature = bytes([0x1A, len(int64_list)]) + int64_list
+            e = bytes([0x0A, 1, ord("x"), 0x12, len(feature)]) + feature
+            return bytes([0x0A, len(e)]) + e
+
+        features_payload = entry(5) + entry(9)  # two map entries, same key
+        record = bytes([0x0A, len(features_payload)]) + features_payload
+        schema = StructType([StructField("x", LongType())])
+        want = ColumnarDecoder(schema).decode_batch([record])
+        got = _native.NativeDecoder(schema).decode_batch([record])
+        assert want["x"].values[0] == 9  # protobuf map: last wins
+        assert got["x"].values[0] == 9
+
+    def test_empty_inner_numeric_feature_raises_named_error(self):
+        from tpu_tfrecord.proto import FeatureList, SequenceExample, encode_sequence_example
+
+        schema = StructType([StructField("toks", ArrayType(LongType()))])
+        se = SequenceExample(feature_lists={"toks": FeatureList([Feature(3, [])])})
+        rec = encode_sequence_example(se)
+        with pytest.raises(ValueError, match="toks"):
+            ColumnarDecoder(schema, RecordType.SEQUENCE_EXAMPLE).decode_batch([rec])
+        with pytest.raises(ValueError, match="empty inner"):
+            _native.NativeDecoder(schema, RecordType.SEQUENCE_EXAMPLE).decode_batch([rec])

@@ -1,0 +1,359 @@
+"""Loader + ctypes bindings for the C++ fast path (src/cc/tfrecord_native.cc).
+
+The native library provides hardware CRC32C, TFRecord frame scanning, and
+batch Example/SequenceExample -> columnar decoding (the components the
+reference delegates to shaded JVM libraries, SURVEY.md §2.8-2.9). ctypes
+releases the GIL during each call, so decode overlaps Python-side work.
+
+The .so is compiled on first import if missing (g++, ~2s, cached under
+tpu_tfrecord/_lib/). Set TPU_TFRECORD_NO_NATIVE=1 to force the pure-Python
+path (the correctness oracle).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import platform
+import subprocess
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tpu_tfrecord import proto
+from tpu_tfrecord.columnar import Column, ColumnarBatch
+from tpu_tfrecord.options import RecordType
+from tpu_tfrecord.schema import (
+    ArrayType,
+    BinaryType,
+    DataType,
+    DecimalType,
+    DoubleType,
+    FloatType,
+    IntegerType,
+    LongType,
+    NullType,
+    StringType,
+    StructType,
+)
+from tpu_tfrecord.serde import NullValueError
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(os.path.dirname(_PKG_DIR), "src", "cc", "tfrecord_native.cc")
+_LIB_DIR = os.path.join(_PKG_DIR, "_lib")
+_LIB_PATH = os.path.join(_LIB_DIR, "libtfrecord_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_error: Optional[str] = None
+
+
+def _build() -> None:
+    os.makedirs(_LIB_DIR, exist_ok=True)
+    # Compile to a per-process temp name and os.replace into place: multiple
+    # processes (process_count > 1 on one host) may race the first build, and
+    # a half-written .so must never be visible under the final name.
+    tmp_path = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    cmd = ["g++", "-std=c++20", "-O3", "-fPIC", "-shared", "-o", tmp_path, _SRC]
+    if platform.machine() == "x86_64":
+        cmd.insert(1, "-msse4.2")
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp_path, _LIB_PATH)
+    finally:
+        if os.path.exists(tmp_path):
+            os.remove(tmp_path)
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+
+    lib.tfr_crc32c.restype = ctypes.c_uint32
+    lib.tfr_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+
+    lib.tfr_scan.restype = ctypes.c_int64
+    lib.tfr_scan.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int32, u64p, u64p, ctypes.c_int64]
+
+    lib.tfr_decode_batch.restype = ctypes.c_void_p
+    lib.tfr_decode_batch.argtypes = [
+        ctypes.c_char_p, u64p, u64p, ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_char_p),
+        i32p, i32p, i32p, u8p, ctypes.c_char_p, ctypes.c_int64,
+    ]
+    for name in ("tfr_result_values",):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_int64
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(ctypes.c_void_p)]
+    for name in ("tfr_result_row_offsets", "tfr_result_inner_offsets", "tfr_result_blob_offsets"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_int64
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(i64p)]
+    lib.tfr_result_blob.restype = ctypes.c_int64
+    lib.tfr_result_blob.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(u8p)]
+    lib.tfr_result_mask.restype = ctypes.c_int64
+    lib.tfr_result_mask.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(u8p)]
+    lib.tfr_result_free.restype = None
+    lib.tfr_result_free.argtypes = [ctypes.c_void_p]
+
+    lib.tfr_frame_records.restype = ctypes.c_int64
+    lib.tfr_frame_records.argtypes = [
+        ctypes.c_char_p, u64p, u64p, ctypes.c_int64, u8p, ctypes.c_int64
+    ]
+    lib.tfr_hash_blob.restype = None
+    lib.tfr_hash_blob.argtypes = [
+        ctypes.c_char_p, i64p, ctypes.c_int64, ctypes.c_int64, i64p
+    ]
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _load_error
+    if _lib is not None:
+        return _lib
+    if os.environ.get("TPU_TFRECORD_NO_NATIVE"):
+        _load_error = "disabled via TPU_TFRECORD_NO_NATIVE"
+        return None
+    with _lock:
+        if _lib is not None:
+            return _lib
+        try:
+            if not os.path.exists(_LIB_PATH) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
+            ):
+                _build()
+            _lib = _bind(ctypes.CDLL(_LIB_PATH))
+        except Exception as e:  # pragma: no cover - depends on toolchain
+            _load_error = str(e)
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def load_error() -> Optional[str]:
+    load()
+    return _load_error
+
+
+# ---------------------------------------------------------------------------
+# High-level wrappers
+# ---------------------------------------------------------------------------
+
+
+def crc32c(data: bytes) -> int:
+    lib = load()
+    assert lib is not None
+    return lib.tfr_crc32c(bytes(data), len(data))
+
+
+_SCAN_ERRORS = {
+    -1: "corrupt TFRecord: bad length CRC",
+    -2: "truncated TFRecord",
+    -3: "corrupt TFRecord: bad data CRC",
+    -4: "scan capacity exceeded",
+}
+
+
+def scan(buf: bytes, verify_crc: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+    """Scan framing over an in-memory buffer -> (offsets, lengths) arrays."""
+    from tpu_tfrecord.wire import TFRecordCorruptionError
+
+    lib = load()
+    assert lib is not None
+    cap = max(1, len(buf) // 16)
+    offsets = np.empty(cap, dtype=np.uint64)
+    lengths = np.empty(cap, dtype=np.uint64)
+    n = lib.tfr_scan(
+        buf,
+        len(buf),
+        1 if verify_crc else 0,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        cap,
+    )
+    if n < 0:
+        raise TFRecordCorruptionError(_SCAN_ERRORS.get(int(n), f"scan error {n}"))
+    # Copy out of the worst-case-capacity backing arrays (sized len(buf)/16
+    # entries) so holding the result doesn't pin ~buf-sized allocations.
+    return offsets[:n].copy(), lengths[:n].copy()
+
+
+# layout/kind/dtype codes must match tfrecord_native.cc
+_LAYOUT_SCALAR, _LAYOUT_RAGGED, _LAYOUT_RAGGED2 = 0, 1, 2
+_DT_I64, _DT_I32, _DT_F32, _DT_F64, _DT_BYTES = 0, 1, 2, 3, -1
+_DT_NP = {_DT_I64: np.int64, _DT_I32: np.int32, _DT_F32: np.float32, _DT_F64: np.float64}
+
+
+def _field_spec(name: str, dtype: DataType) -> Tuple[int, int, int]:
+    """(layout, kind, out_dtype) for a schema field; raises if unsupported
+    natively (caller falls back to Python)."""
+    elem: DataType = dtype
+    layout = _LAYOUT_SCALAR
+    if isinstance(dtype, ArrayType):
+        if isinstance(dtype.element_type, ArrayType):
+            layout = _LAYOUT_RAGGED2
+            elem = dtype.element_type.element_type
+            if isinstance(elem, ArrayType):
+                raise ValueError(">2-level nesting")
+        else:
+            layout = _LAYOUT_RAGGED
+            elem = dtype.element_type
+    if isinstance(elem, IntegerType):
+        return layout, proto.INT64_LIST, _DT_I32
+    if isinstance(elem, LongType):
+        return layout, proto.INT64_LIST, _DT_I64
+    if isinstance(elem, FloatType):
+        return layout, proto.FLOAT_LIST, _DT_F32
+    if isinstance(elem, (DoubleType, DecimalType)):
+        return layout, proto.FLOAT_LIST, _DT_F64
+    if isinstance(elem, (StringType, BinaryType)):
+        return layout, proto.BYTES_LIST, _DT_BYTES
+    raise ValueError(f"unsupported native type {elem}")
+
+
+class NativeDecoder:
+    """Batch decoder backed by the C++ library. Interface mirrors
+    columnar.ColumnarDecoder but consumes (buf, offsets, lengths) spans."""
+
+    def __init__(self, schema: StructType, record_type: RecordType = RecordType.EXAMPLE):
+        lib = load()
+        if lib is None:
+            raise RuntimeError(f"native library unavailable: {_load_error}")
+        self._lib = lib
+        self.schema = schema
+        self.record_type = RecordType.parse(record_type)
+        if self.record_type == RecordType.BYTE_ARRAY:
+            raise ValueError("ByteArray decoding has no native path (trivial in Python)")
+        n = len(schema)
+        self._names = [f.name.encode("utf-8") for f in schema]
+        self._c_names = (ctypes.c_char_p * n)(*self._names)
+        specs = [_field_spec(f.name, f.data_type) for f in schema]
+        self._layouts = np.array([s[0] for s in specs], dtype=np.int32)
+        self._kinds = np.array([s[1] for s in specs], dtype=np.int32)
+        self._dtypes = np.array([s[2] for s in specs], dtype=np.int32)
+        self._nullables = np.array([1 if f.nullable else 0 for f in schema], dtype=np.uint8)
+        self._fmt = 0 if self.record_type == RecordType.EXAMPLE else 1
+
+    def decode_spans(
+        self, buf: bytes, offsets: np.ndarray, lengths: np.ndarray
+    ) -> ColumnarBatch:
+        lib = self._lib
+        n_records = len(offsets)
+        errbuf = ctypes.create_string_buffer(512)
+        offsets = np.ascontiguousarray(offsets, dtype=np.uint64)
+        lengths = np.ascontiguousarray(lengths, dtype=np.uint64)
+        handle = lib.tfr_decode_batch(
+            buf,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            n_records,
+            self._fmt,
+            len(self.schema),
+            self._c_names,
+            self._layouts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            self._kinds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            self._dtypes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            self._nullables.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            errbuf,
+            len(errbuf),
+        )
+        if not handle:
+            msg = errbuf.value.decode("utf-8", "replace")
+            if "does not allow null values" in msg:
+                raise NullValueError(msg)
+            raise ValueError(f"native decode failed: {msg}")
+        try:
+            return self._extract(handle, n_records)
+        finally:
+            lib.tfr_result_free(handle)
+
+    def decode_batch(self, records) -> ColumnarBatch:
+        """List-of-bytes interface (drop-in for ColumnarDecoder): records are
+        packed into one contiguous buffer then decoded in a single call."""
+        lengths = np.array([len(r) for r in records], dtype=np.uint64)
+        offsets = np.zeros(len(records), dtype=np.uint64)
+        np.cumsum(lengths[:-1], out=offsets[1:])
+        buf = b"".join(records)
+        return self.decode_spans(buf, offsets, lengths)
+
+    def _extract(self, handle, n_records: int) -> ColumnarBatch:
+        lib = self._lib
+        cols: Dict[str, Column] = {}
+        for i, field in enumerate(self.schema):
+            layout = int(self._layouts[i])
+            dt = int(self._dtypes[i])
+            col = Column(field.name, field.data_type)
+
+            mptr = ctypes.POINTER(ctypes.c_uint8)()
+            mlen = lib.tfr_result_mask(handle, i, ctypes.byref(mptr))
+            col.mask = _np_copy(mptr, mlen, np.uint8).astype(bool)
+
+            if layout != _LAYOUT_SCALAR:
+                optr = ctypes.POINTER(ctypes.c_int64)()
+                olen = lib.tfr_result_row_offsets(handle, i, ctypes.byref(optr))
+                col.offsets = _np_copy(optr, olen * 8, np.int64)
+            if layout == _LAYOUT_RAGGED2:
+                iptr = ctypes.POINTER(ctypes.c_int64)()
+                ilen = lib.tfr_result_inner_offsets(handle, i, ctypes.byref(iptr))
+                col.inner_offsets = _np_copy(iptr, ilen * 8, np.int64)
+
+            if dt == _DT_BYTES:
+                bptr = ctypes.POINTER(ctypes.c_uint8)()
+                blen = lib.tfr_result_blob(handle, i, ctypes.byref(bptr))
+                col.blob = _np_copy(bptr, blen, np.uint8).tobytes()
+                boptr = ctypes.POINTER(ctypes.c_int64)()
+                bolen = lib.tfr_result_blob_offsets(handle, i, ctypes.byref(boptr))
+                col.blob_offsets = _np_copy(boptr, bolen * 8, np.int64)
+            else:
+                vptr = ctypes.c_void_p()
+                vbytes = lib.tfr_result_values(handle, i, ctypes.byref(vptr))
+                col.values = _np_copy(
+                    ctypes.cast(vptr, ctypes.POINTER(ctypes.c_uint8)), vbytes, _DT_NP[dt]
+                )
+            cols[field.name] = col
+        return ColumnarBatch(cols, n_records)
+
+
+def _np_copy(ptr, nbytes: int, dtype) -> np.ndarray:
+    if nbytes == 0 or not ptr:
+        return np.empty(0, dtype=dtype)
+    raw = ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8 * nbytes)).contents
+    # single copy out of the C++-owned buffer
+    return np.frombuffer(raw, dtype=dtype).copy()
+
+
+def hash_blob(blob: bytes, blob_offsets: np.ndarray, num_buckets: int) -> np.ndarray:
+    """CRC32C-hash each blob value into [0, num_buckets) — one native call."""
+    lib = load()
+    assert lib is not None
+    n = len(blob_offsets) - 1
+    out = np.empty(n, dtype=np.int64)
+    bo = np.ascontiguousarray(blob_offsets, dtype=np.int64)
+    lib.tfr_hash_blob(
+        blob,
+        bo.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        num_buckets,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out
+
+
+def make_decoder(schema: StructType, record_type) -> Optional[NativeDecoder]:
+    """NativeDecoder if the schema/record type is natively supported and the
+    library loads, else None (caller uses the Python ColumnarDecoder)."""
+    rt = RecordType.parse(record_type) if not isinstance(record_type, RecordType) else record_type
+    if rt == RecordType.BYTE_ARRAY or not available():
+        return None
+    try:
+        return NativeDecoder(schema, rt)
+    except ValueError:
+        return None

@@ -47,7 +47,6 @@ from tpu_tfrecord.schema import (
 from tpu_tfrecord.serde import NullValueError
 
 
-@dataclass
 class Column:
     """One decoded column. Exactly one of the layouts below is populated.
 
@@ -57,24 +56,62 @@ class Column:
       (offsets indexes into inner_offsets: row i spans inner lists
       offsets[i]:offsets[i+1], inner list j spans values
       inner_offsets[j]:inner_offsets[j+1])
-    - bytes-like: ``blobs`` (flat list) with the same offsets scheme
+    - bytes-like: one flat ``blob`` buffer + ``blob_offsets`` [n_values+1]
+      value boundaries (with the same offsets scheme above it) — per-value
+      Python objects are only materialized on demand via ``blobs``.
     """
 
-    name: str
-    dtype: DataType
-    values: Optional[np.ndarray] = None
-    offsets: Optional[np.ndarray] = None
-    inner_offsets: Optional[np.ndarray] = None
-    blobs: Optional[List[bytes]] = None
-    mask: Optional[np.ndarray] = None  # validity per row
+    __slots__ = ("name", "dtype", "values", "offsets", "inner_offsets",
+                 "blob", "blob_offsets", "mask")
+
+    def __init__(
+        self,
+        name: str,
+        dtype: DataType,
+        values: Optional[np.ndarray] = None,
+        offsets: Optional[np.ndarray] = None,
+        inner_offsets: Optional[np.ndarray] = None,
+        blob: Optional[bytes] = None,
+        blob_offsets: Optional[np.ndarray] = None,
+        mask: Optional[np.ndarray] = None,
+    ):
+        self.name = name
+        self.dtype = dtype
+        self.values = values
+        self.offsets = offsets
+        self.inner_offsets = inner_offsets
+        self.blob = blob
+        self.blob_offsets = blob_offsets
+        self.mask = mask  # validity per row
 
     @property
     def is_ragged(self) -> bool:
         return self.offsets is not None
 
+    @property
+    def is_bytes(self) -> bool:
+        return self.blob is not None
+
     def row_lengths(self) -> np.ndarray:
         assert self.offsets is not None
         return np.diff(self.offsets)
+
+    @property
+    def blobs(self) -> Optional[List[bytes]]:
+        """Materialize per-value bytes objects (view concern — the hot path
+        works on the flat ``blob`` + ``blob_offsets`` arrays)."""
+        if self.blob is None:
+            return None
+        bo = self.blob_offsets
+        blob = self.blob
+        return [bytes(blob[bo[j] : bo[j + 1]]) for j in range(len(bo) - 1)]
+
+    def set_blobs(self, items: Sequence[bytes]) -> None:
+        self.blob = b"".join(items)
+        self.blob_offsets = np.concatenate(
+            ([0], np.cumsum(np.fromiter((len(b) for b in items), dtype=np.int64,
+                                        count=len(items))))
+        ) if items else np.zeros(1, dtype=np.int64)
 
 
 @dataclass
@@ -195,6 +232,10 @@ class _FieldAcc:
                     if self.kind == proto.BYTES_LIST:
                         self.blobs.append(f.values[0] if len(f.values) else b"")
                     else:
+                        if not len(f.values):
+                            raise ValueError(
+                                f"column {self.name}: empty inner feature"
+                            )
                         self.values.append(f.values[0])
                 return
             raise ValueError(f"column {self.name}: FeatureList for scalar type")
@@ -211,22 +252,31 @@ class _FieldAcc:
 
     # -- finalize -------------------------------------------------------------
 
+    def _values_array(self) -> np.ndarray:
+        if self.kind == proto.INT64_LIST:
+            arr = np.asarray(self.values, dtype=np.int64)
+            if self.np_dtype != np.int64:
+                # IntegerType: two's-complement truncation (Scala Long.toInt)
+                arr = arr.astype(self.np_dtype)
+            return arr
+        return np.asarray(self.values, dtype=self.np_dtype)
+
     def build(self, num_rows: int) -> Column:
         mask = np.asarray(self.mask, dtype=bool)
         col = Column(self.name, self.dtype, mask=mask)
         if self.layout == "scalar":
             if self.kind == proto.BYTES_LIST:
-                col.blobs = self.blobs
+                col.set_blobs(self.blobs)
             else:
-                col.values = np.asarray(self.values, dtype=self.np_dtype)
+                col.values = self._values_array()
         elif self.layout == "ragged":
             col.offsets = np.concatenate(
                 ([0], np.cumsum(np.asarray(self.lengths, dtype=np.int64)))
             )
             if self.kind == proto.BYTES_LIST:
-                col.blobs = self.blobs
+                col.set_blobs(self.blobs)
             else:
-                col.values = np.asarray(self.values, dtype=self.np_dtype)
+                col.values = self._values_array()
         else:
             col.offsets = np.concatenate(
                 ([0], np.cumsum(np.asarray(self.lengths, dtype=np.int64)))
@@ -235,9 +285,9 @@ class _FieldAcc:
                 ([0], np.cumsum(np.asarray(self.inner_lengths, dtype=np.int64)))
             )
             if self.kind == proto.BYTES_LIST:
-                col.blobs = self.blobs
+                col.set_blobs(self.blobs)
             else:
-                col.values = np.asarray(self.values, dtype=self.np_dtype)
+                col.values = self._values_array()
         return col
 
 
@@ -299,6 +349,90 @@ class ColumnarDecoder:
 # ---------------------------------------------------------------------------
 # Ragged -> dense padding (host-side, numpy)
 # ---------------------------------------------------------------------------
+
+
+def _slice_blob(col: Column, new: Column, v0: int, v1: int) -> None:
+    bo = col.blob_offsets
+    b0, b1 = int(bo[v0]), int(bo[v1])
+    new.blob = col.blob[b0:b1]
+    new.blob_offsets = bo[v0 : v1 + 1] - b0
+
+
+def slice_batch(batch: ColumnarBatch, start: int, stop: int) -> ColumnarBatch:
+    """Row-range view (copy) of a batch — used to cut fixed-size training
+    batches out of larger decode chunks."""
+    start = max(0, start)
+    stop = min(batch.num_rows, stop)
+    out: Dict[str, Column] = {}
+    for name, col in batch.columns.items():
+        new = Column(name, col.dtype, mask=col.mask[start:stop] if col.mask is not None else None)
+        if col.inner_offsets is not None:  # ragged2
+            o0, o1 = int(col.offsets[start]), int(col.offsets[stop])
+            inner = col.inner_offsets[o0 : o1 + 1]
+            v0, v1 = int(inner[0]), int(inner[-1])
+            new.offsets = col.offsets[start : stop + 1] - o0
+            new.inner_offsets = inner - v0
+            if col.values is not None:
+                new.values = col.values[v0:v1]
+            if col.blob is not None:
+                _slice_blob(col, new, v0, v1)
+        elif col.offsets is not None:  # ragged
+            v0, v1 = int(col.offsets[start]), int(col.offsets[stop])
+            new.offsets = col.offsets[start : stop + 1] - v0
+            if col.values is not None:
+                new.values = col.values[v0:v1]
+            if col.blob is not None:
+                _slice_blob(col, new, v0, v1)
+        else:  # scalar
+            if col.values is not None:
+                new.values = col.values[start:stop]
+            if col.blob is not None:
+                _slice_blob(col, new, start, stop)
+        out[name] = new
+    return ColumnarBatch(out, stop - start)
+
+
+def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
+    """Concatenate batches row-wise (all must share the same columns)."""
+    if len(batches) == 1:
+        return batches[0]
+    first = batches[0]
+    out: Dict[str, Column] = {}
+    for name, col0 in first.columns.items():
+        cols = [b.columns[name] for b in batches]
+        new = Column(name, col0.dtype)
+        if col0.mask is not None:
+            new.mask = np.concatenate([c.mask for c in cols])
+        if col0.inner_offsets is not None:
+            new.offsets = _concat_offsets([np.asarray(c.offsets) for c in cols])
+            new.inner_offsets = _concat_offsets(
+                [np.asarray(c.inner_offsets) for c in cols]
+            )
+        elif col0.offsets is not None:
+            new.offsets = _concat_offsets([np.asarray(c.offsets) for c in cols])
+        if col0.values is not None:
+            new.values = np.concatenate([c.values for c in cols])
+        if col0.blob is not None:
+            new.blob = b"".join(c.blob for c in cols)
+            new.blob_offsets = _concat_offsets(
+                [np.asarray(c.blob_offsets) for c in cols]
+            )
+        out[name] = new
+    return ColumnarBatch(out, sum(b.num_rows for b in batches))
+
+
+def _concat_offsets(offset_arrays: List[np.ndarray]) -> np.ndarray:
+    total = sum(len(o) - 1 for o in offset_arrays)
+    out = np.empty(total + 1, dtype=np.int64)
+    out[0] = 0
+    pos = 0
+    base = 0
+    for o in offset_arrays:
+        n = len(o) - 1
+        out[pos + 1 : pos + 1 + n] = o[1:] + base
+        base += int(o[-1])
+        pos += n
+    return out
 
 
 def pad_ragged(

@@ -23,8 +23,14 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
-from tpu_tfrecord import wire
-from tpu_tfrecord.columnar import Column, ColumnarBatch, ColumnarDecoder
+from tpu_tfrecord import _native, wire
+from tpu_tfrecord.columnar import (
+    Column,
+    ColumnarBatch,
+    ColumnarDecoder,
+    concat_batches,
+    slice_batch,
+)
 from tpu_tfrecord.io import paths as p
 from tpu_tfrecord.io.reader import DatasetReader
 from tpu_tfrecord.metrics import METRICS, timed
@@ -95,12 +101,43 @@ class TFRecordDataset:
             sh for i, sh in enumerate(all_shards) if i % process_count == process_index
         ]
         self._decoder = ColumnarDecoder(self._data_schema, self.options.record_type)
+        self._native_decoder = _native.make_decoder(
+            self._data_schema, self.options.record_type
+        )
 
-    # -- raw record stream with positional accounting -----------------------
+    # -- chunked decode stream with positional accounting --------------------
+    #
+    # Each shard is loaded (decompressed) into one buffer, frame-scanned in a
+    # single native call, and decoded in large chunks (one C++ call per
+    # chunk, GIL released). Chunks carry (epoch, cursor, start_offset) so any
+    # row boundary maps back to an exact resume position.
 
-    def _record_stream(self, state: IteratorState) -> Iterator[tuple]:
-        """Yield (record_bytes, shard_cursor, record_offset_after) from the
+    def _decode_chunk(self, buf, offsets, lengths) -> ColumnarBatch:
+        if self._native_decoder is not None:
+            return self._native_decoder.decode_spans(buf, offsets, lengths)
+        records = [
+            bytes(buf[o : o + l]) for o, l in zip(offsets.tolist(), lengths.tolist())
+        ]
+        return self._decoder.decode_batch(records)
+
+    def _shard_spans(self, shard) -> tuple:
+        """Load one shard fully and return (buf, offsets, lengths)."""
+        codec = wire.codec_from_path(shard.path)
+        with wire.open_compressed(shard.path, "rb", codec) as fh:
+            buf = fh.read()
+        if not buf:
+            return buf, np.empty(0, np.uint64), np.empty(0, np.uint64)
+        if _native.available():
+            return (buf, *_native.scan(buf, self.options.verify_crc))
+        spans = list(wire.scan_buffer(buf, self.options.verify_crc))
+        offsets = np.array([s for s, _ in spans], dtype=np.uint64)
+        lengths = np.array([l for _, l in spans], dtype=np.uint64)
+        return buf, offsets, lengths
+
+    def _chunk_stream(self, state: IteratorState) -> Iterator[tuple]:
+        """Yield (chunk: ColumnarBatch, epoch, cursor, start_offset) from the
         resume point onward, across epochs."""
+        chunk_records = max(self.batch_size, 2048)
         epoch = state.epoch
         while self.num_epochs is None or epoch < self.num_epochs:
             start_cursor = state.shard_cursor if epoch == state.epoch else 0
@@ -111,15 +148,44 @@ class TFRecordDataset:
                     if (epoch == state.epoch and cursor == state.shard_cursor)
                     else 0
                 )
-                offset = 0
-                for record in wire.read_records(
-                    shard.path, verify_crc=self.options.verify_crc
-                ):
-                    offset += 1
-                    if offset <= skip:
-                        continue
-                    yield record, epoch, cursor, offset
+                buf, offsets, lengths = self._shard_spans(shard)
+                n = len(offsets)
+                for start in range(skip, n, chunk_records):
+                    stop = min(start + chunk_records, n)
+                    with timed("decode", METRICS) as t:
+                        chunk = self._decode_chunk(
+                            buf, offsets[start:stop], lengths[start:stop]
+                        )
+                        t.records += chunk.num_rows
+                        t.bytes += int(lengths[start:stop].sum())
+                    if self._partition_fields:
+                        self._attach_partition_chunk(chunk, cursor)
+                    yield chunk, epoch, cursor, start
             epoch += 1
+
+    def _attach_partition_chunk(self, chunk: ColumnarBatch, cursor: int) -> None:
+        """Partition values are constant within a shard: materialize them as
+        constant columns over the chunk."""
+        from tpu_tfrecord.io.paths import cast_partition_value
+        from tpu_tfrecord.schema import numpy_dtype
+
+        n = chunk.num_rows
+        for f in self._partition_fields:
+            raw = self.shards[cursor].partitions.get(f.name)
+            val = cast_partition_value(raw, f.data_type)
+            col = Column(
+                f.name,
+                f.data_type,
+                mask=np.full(n, val is not None, dtype=bool),
+            )
+            np_dt = numpy_dtype(f.data_type)
+            if np_dt is None:
+                item = val.encode("utf-8") if val is not None else b""
+                col.blob = item * n
+                col.blob_offsets = np.arange(n + 1, dtype=np.int64) * len(item)
+            else:
+                col.values = np.full(n, val if val is not None else 0, dtype=np_dt)
+            chunk.columns[f.name] = col
 
     # -- batched iteration ---------------------------------------------------
 
@@ -127,29 +193,6 @@ class TFRecordDataset:
         self, state: Optional[IteratorState] = None
     ) -> "CheckpointableIterator":
         return CheckpointableIterator(self, state or IteratorState())
-
-
-def _attach_partition_columns(
-    batch: ColumnarBatch, cursors: List[int], ds: TFRecordDataset
-) -> None:
-    """Materialize requested partition columns per row: each record's value
-    comes from the ``col=value`` path of the shard it was read from."""
-    from tpu_tfrecord.io.paths import cast_partition_value
-    from tpu_tfrecord.schema import numpy_dtype
-
-    for f in ds._partition_fields:
-        raw = [ds.shards[c].partitions.get(f.name) for c in cursors]
-        vals = [cast_partition_value(r, f.data_type) for r in raw]
-        mask = np.array([v is not None for v in vals], dtype=bool)
-        col = Column(f.name, f.data_type, mask=mask)
-        np_dt = numpy_dtype(f.data_type)
-        if np_dt is None:  # string partition column
-            col.blobs = [(v.encode("utf-8") if v is not None else b"") for v in vals]
-        else:
-            col.values = np.array(
-                [v if v is not None else 0 for v in vals], dtype=np_dt
-            )
-        batch.columns[f.name] = col
 
 
 class CheckpointableIterator:
@@ -172,34 +215,45 @@ class CheckpointableIterator:
 
     def _producer(self) -> None:
         ds = self._ds
+        B = ds.batch_size
         try:
-            buf: List[bytes] = []
-            cursors: List[int] = []
-            end_pos = self._start
-            for record, epoch, cursor, offset in ds._record_stream(self._start):
-                buf.append(record)
-                cursors.append(cursor)
-                end_pos = IteratorState(epoch, cursor, offset)
-                if len(buf) >= ds.batch_size:
-                    if not self._emit(buf, cursors, end_pos):
+            # pending: [chunk, consumed_rows, epoch, cursor, chunk_start]
+            pending: List[list] = []
+            avail = 0
+            for chunk, epoch, cursor, chunk_start in ds._chunk_stream(self._start):
+                if self._stop.is_set():
+                    return
+                if chunk.num_rows == 0:
+                    continue
+                pending.append([chunk, 0, epoch, cursor, chunk_start])
+                avail += chunk.num_rows
+                while avail >= B:
+                    if not self._emit_from(pending, B):
                         return
-                    buf, cursors = [], []
-            if buf and not ds.drop_remainder:
-                self._emit(buf, cursors, end_pos)
+                    avail -= B
+            if avail and not ds.drop_remainder:
+                self._emit_from(pending, avail)
             self._queue.put(None)
         except BaseException as e:  # propagate to consumer
             self._queue.put(e)
 
-    def _emit(
-        self, records: List[bytes], cursors: List[int], end_pos: IteratorState
-    ) -> bool:
-        ds = self._ds
-        with timed("decode", METRICS) as t:
-            batch = ds._decoder.decode_batch(records)
-            t.records += batch.num_rows
-            t.bytes += sum(len(r) for r in records)
-        if ds._partition_fields:
-            _attach_partition_columns(batch, cursors, ds)
+    def _emit_from(self, pending: List[list], n: int) -> bool:
+        """Assemble a batch of n rows from the front of the pending chunks;
+        the resume state is the position after the batch's last row."""
+        slices = []
+        need = n
+        end_pos = self._start
+        while need:
+            entry = pending[0]
+            chunk, consumed, epoch, cursor, chunk_start = entry
+            take = min(need, chunk.num_rows - consumed)
+            slices.append(slice_batch(chunk, consumed, consumed + take))
+            entry[1] = consumed + take
+            need -= take
+            end_pos = IteratorState(epoch, cursor, chunk_start + entry[1])
+            if entry[1] >= chunk.num_rows:
+                pending.pop(0)
+        batch = concat_batches(slices)
         while not self._stop.is_set():
             try:
                 self._queue.put((batch, end_pos), timeout=0.1)

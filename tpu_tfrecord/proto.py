@@ -306,34 +306,34 @@ def encode_sequence_example(se: SequenceExample) -> bytes:
 
 
 def _parse_feature(buf, start: int, end: int) -> Feature:
+    # Repeated encounters of the same list field MERGE (protobuf submessage
+    # merge semantics): values concatenate. A different oneof kind replaces.
     kind: Optional[int] = None
     values: Union[List[bytes], List[int], List[float]] = []
     for fnum, wtype, vstart, vend in _iter_fields(buf, start, end):
         if fnum == BYTES_LIST and wtype == _WT_LEN:
-            kind = BYTES_LIST
-            vals: List[bytes] = []
+            if kind != BYTES_LIST:
+                kind, values = BYTES_LIST, []
             for inum, iwt, istart, iend in _iter_fields(buf, vstart, vend):
                 if inum == 1 and iwt == _WT_LEN:
-                    vals.append(bytes(buf[istart:iend]))
-            values = vals
+                    values.append(bytes(buf[istart:iend]))
         elif fnum == FLOAT_LIST and wtype == _WT_LEN:
-            kind = FLOAT_LIST
-            fvals: List[float] = []
+            if kind != FLOAT_LIST:
+                kind, values = FLOAT_LIST, []
             for inum, iwt, istart, iend in _iter_fields(buf, vstart, vend):
                 if inum != 1:
                     continue
                 if iwt == _WT_LEN:  # packed
                     if (iend - istart) % 4:
                         raise ProtoDecodeError("packed float payload not 4-aligned")
-                    fvals.extend(
+                    values.extend(
                         np.frombuffer(buf, dtype="<f4", count=(iend - istart) // 4, offset=istart).tolist()
                     )
                 elif iwt == _WT_I32:  # unpacked
-                    fvals.append(struct.unpack_from("<f", buf, istart)[0])
-            values = fvals
+                    values.append(struct.unpack_from("<f", buf, istart)[0])
         elif fnum == INT64_LIST and wtype == _WT_LEN:
-            kind = INT64_LIST
-            ivals: List[int] = []
+            if kind != INT64_LIST:
+                kind, values = INT64_LIST, []
             for inum, iwt, istart, iend in _iter_fields(buf, vstart, vend):
                 if inum != 1:
                     continue
@@ -341,11 +341,10 @@ def _parse_feature(buf, start: int, end: int) -> Feature:
                     pos = istart
                     while pos < iend:
                         raw, pos = _read_varint(buf, pos)
-                        ivals.append(_unsigned_to_i64(raw))
+                        values.append(_unsigned_to_i64(raw))
                 elif iwt == _WT_VARINT:  # unpacked
                     raw, _ = _read_varint(buf, istart)
-                    ivals.append(_unsigned_to_i64(raw))
-            values = ivals
+                    values.append(_unsigned_to_i64(raw))
     return Feature(kind, values)
 
 

@@ -55,8 +55,10 @@ def batch_spec(
     - numeric array column             -> (B, L) + '<name>_len' (B,) int32
     - array-of-array column            -> (B, Lo, Li) + '<name>_len' (B,)
                                           + '<name>_inner_len' (B, Lo)
-    - string/binary column             -> (B,) int64 iff hashed via
+    - string/binary column             -> (B,) int32 iff hashed via
                                           ``hash_buckets[name]``, else omitted
+                                          (int32: embedding row indices —
+                                          half the transfer bytes of int64)
     ``pad_to`` must give L (or (Lo, Li)) for every ragged column — static
     shapes are what let XLA tile the downstream compute onto the MXU.
     """
@@ -67,7 +69,7 @@ def batch_spec(
         dt = f.data_type
         if _is_bytes_like(dt):
             if f.name in hash_buckets:
-                spec[f.name] = jax.ShapeDtypeStruct((batch_size,), np.int64)
+                spec[f.name] = jax.ShapeDtypeStruct((batch_size,), np.int32)
             continue
         if isinstance(dt, ArrayType):
             if isinstance(dt.element_type, ArrayType):
@@ -95,10 +97,26 @@ def batch_spec(
 # ---------------------------------------------------------------------------
 
 
-def hash_bytes_column(blobs: List[bytes], num_buckets: int) -> np.ndarray:
+def hash_bytes_column(col_or_blobs, num_buckets: int) -> np.ndarray:
     """Deterministic CRC32C-based hashing of byte strings into buckets —
-    the host-side categorical-feature path (strings never go to the TPU)."""
-    out = np.empty(len(blobs), dtype=np.int64)
+    the host-side categorical-feature path (strings never go to the TPU).
+    Accepts a bytes-like Column (flat blob path, hashed in one native call)
+    or a plain list of bytes."""
+    if isinstance(col_or_blobs, Column):
+        col = col_or_blobs
+        try:
+            from tpu_tfrecord import _native
+
+            if _native.available():
+                return _native.hash_blob(
+                    col.blob, col.blob_offsets, num_buckets
+                ).astype(np.int32)
+        except Exception:
+            pass
+        blobs = col.blobs
+    else:
+        blobs = col_or_blobs
+    out = np.empty(len(blobs), dtype=np.int32)
     c32 = wire.crc32c
     for i, b in enumerate(blobs):
         out[i] = c32(b) % num_buckets
@@ -111,8 +129,15 @@ def host_batch_from_columnar(
     pad_to: Optional[Dict[str, Union[int, tuple]]] = None,
     hash_buckets: Optional[Dict[str, int]] = None,
     include_lengths: bool = True,
+    pack: Optional[Dict[str, List[str]]] = None,
 ) -> Dict[str, np.ndarray]:
-    """ColumnarBatch -> dict of dense numpy arrays matching batch_spec."""
+    """ColumnarBatch -> dict of dense numpy arrays matching batch_spec.
+
+    ``pack`` groups same-dtype scalar columns into one [B, K] array
+    (``{"dense": ["I1", ...], "cat": ["C1", ...]}``) — fewer, larger
+    device transfers (one dispatch per group instead of per column) and the
+    natural layout for MXU-bound consumers like the DLRM model.
+    """
     pad_to = pad_to or {}
     hash_buckets = hash_buckets or {}
     out: Dict[str, np.ndarray] = {}
@@ -123,7 +148,7 @@ def host_batch_from_columnar(
             if f.name in hash_buckets:
                 if col.is_ragged:
                     raise ValueError(f"{f.name}: hashing ragged bytes unsupported")
-                out[f.name] = hash_bytes_column(col.blobs, hash_buckets[f.name])
+                out[f.name] = hash_bytes_column(col, hash_buckets[f.name])
             continue
         if isinstance(dt, ArrayType):
             if isinstance(dt.element_type, ArrayType):
@@ -150,6 +175,10 @@ def host_batch_from_columnar(
                     out[f.name + "_len"] = lengths
         else:
             out[f.name] = col.values
+    if pack:
+        for group, names in pack.items():
+            cols = [out.pop(n) for n in names]
+            out[group] = np.stack(cols, axis=1)
     return out
 
 

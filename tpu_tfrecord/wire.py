@@ -84,8 +84,23 @@ def crc32c_py(data: bytes, crc: int = 0) -> int:
     return crc ^ 0xFFFFFFFF
 
 
-# Swapped in by tpu_tfrecord._native when the C++ extension is available.
-crc32c = crc32c_py
+def _crc32c_bootstrap(data: bytes) -> int:
+    """First call probes for the C++ library and rebinds ``crc32c`` to the
+    fastest available implementation (hardware CRC32 via SSE4.2)."""
+    global crc32c
+    impl = crc32c_py
+    try:
+        from tpu_tfrecord import _native
+
+        if _native.available():
+            impl = _native.crc32c
+    except Exception:
+        pass
+    crc32c = impl
+    return impl(data)
+
+
+crc32c = _crc32c_bootstrap
 
 _MASK_DELTA = 0xA282EAD8
 
