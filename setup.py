@@ -1,0 +1,36 @@
+"""Build hook: compile the native fast path into the package.
+
+`python setup.py build_native` (or any build that triggers it) produces
+tpu_tfrecord/_lib/libtfrecord_native.so via g++. The library is optional —
+tpu_tfrecord._native also compiles it lazily on first use, and every code
+path has a pure-Python fallback — so build failures are non-fatal.
+"""
+
+import subprocess
+import sys
+
+from setuptools import Command, setup
+
+
+class BuildNative(Command):
+    description = "compile tpu_tfrecord/csrc/tfrecord_native.cc into tpu_tfrecord/_lib/"
+    user_options = []
+
+    def initialize_options(self):
+        pass
+
+    def finalize_options(self):
+        pass
+
+    def run(self):
+        import os
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from tpu_tfrecord import _native
+
+        if _native.available():
+            print(f"native library built: {_native._LIB_PATH}")
+        else:
+            print(f"native build unavailable: {_native.load_error()}", file=sys.stderr)
+
+
+setup(cmdclass={"build_native": BuildNative})

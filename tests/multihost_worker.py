@@ -1,0 +1,86 @@
+"""Worker process for multi-host tests: spawned N times by test_multihost.py.
+
+Each process initializes jax.distributed against a shared coordinator,
+reads ITS shard assignment of a common dataset, runs the distributed schema
+merge, assembles a global sharded batch, and prints JSON results for the
+parent to compare.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    coord = sys.argv[1]
+    num_procs = int(sys.argv[2])
+    pid = int(sys.argv[3])
+    data_dir = sys.argv[4]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tpu_tfrecord.tpu import distributed
+
+    distributed.initialize(coord, num_procs, pid)
+    assert jax.process_count() == num_procs, jax.process_count()
+
+    import numpy as np
+
+    from tpu_tfrecord import wire
+    from tpu_tfrecord.infer import infer_from_records
+    from tpu_tfrecord.io.dataset import TFRecordDataset
+    from tpu_tfrecord.io.paths import discover_shards
+    from tpu_tfrecord.options import RecordType
+    from tpu_tfrecord.tpu.mesh import assign_shards, create_mesh
+
+    # --- distributed schema inference: per-host seqOp + allgather combOp ---
+    shards = discover_shards(data_dir)
+    mine = assign_shards(shards)
+    local_map = {}
+    from tpu_tfrecord.infer import merge_type_maps
+
+    for sh in mine:
+        partial = infer_from_records(
+            wire.read_records(sh.path), RecordType.EXAMPLE
+        )
+        local_map = merge_type_maps(local_map, partial)
+    schema = distributed.merge_schema_across_hosts(local_map)
+    distributed.assert_same_across_hosts(schema.json().encode(), "schema")
+
+    # --- global batch assembly across processes ---
+    mesh = create_mesh()  # all global devices on 'data'
+    ds = TFRecordDataset(
+        data_dir,
+        batch_size=8,  # per-host rows
+        schema=schema,
+        process_index=pid,
+        process_count=num_procs,
+    )
+    with ds.batches() as it:
+        cb = next(it)
+    from tpu_tfrecord.tpu import host_batch_from_columnar, make_global_batch
+
+    hb = host_batch_from_columnar(cb, ds.schema)
+    gb = make_global_batch(hb, mesh)
+    uid = gb["uid"]
+    global_sum = float(jax.jit(lambda x: x.sum())(uid))
+
+    print(
+        json.dumps(
+            {
+                "pid": pid,
+                "schema": schema.json(),
+                "n_shards": len(mine),
+                "global_shape": list(uid.shape),
+                "global_sum": global_sum,
+                "local_rows": int(hb["uid"].shape[0]),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

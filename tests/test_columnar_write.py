@@ -1,0 +1,167 @@
+"""Tests for the columnar (native-encode) write path."""
+
+import numpy as np
+import pytest
+
+import tpu_tfrecord.io as tfio
+from tpu_tfrecord import _native
+from tpu_tfrecord.columnar import ColumnarDecoder, batch_to_rows
+from tpu_tfrecord.io.dataset import TFRecordDataset
+from tpu_tfrecord.io.writer import DatasetWriter
+from tpu_tfrecord.options import RecordType, TFRecordOptions
+from tpu_tfrecord.schema import (
+    ArrayType,
+    BinaryType,
+    DecimalType,
+    DoubleType,
+    FloatType,
+    IntegerType,
+    LongType,
+    StringType,
+    StructField,
+    StructType,
+)
+from tpu_tfrecord.serde import NullValueError, TFRecordSerializer, encode_row
+
+SCHEMA = StructType(
+    [
+        StructField("i", IntegerType()),
+        StructField("l", LongType()),
+        StructField("f", FloatType()),
+        StructField("d", DoubleType()),
+        StructField("s", StringType()),
+        StructField("b", BinaryType()),
+        StructField("fv", ArrayType(FloatType())),
+        StructField("lv", ArrayType(LongType())),
+        StructField("sv", ArrayType(StringType())),
+    ]
+)
+
+
+def make_batch(n=100, with_nulls=False):
+    rows = []
+    for k in range(n):
+        rows.append(
+            [
+                k,
+                k * (2**33),
+                k / 2.0,
+                None if (with_nulls and k % 5 == 0) else k / 4.0,
+                f"s{k}",
+                bytes([k % 256]),
+                [float(j) for j in range(k % 4)],
+                [k, k + 1],
+                [f"t{j}" for j in range(k % 3)],
+            ]
+        )
+    ser = TFRecordSerializer(SCHEMA)
+    records = [encode_row(ser, RecordType.EXAMPLE, r) for r in rows]
+    return ColumnarDecoder(SCHEMA).decode_batch(records), rows
+
+
+class TestColumnarWrite:
+    def test_round_trip(self, sandbox):
+        batch, rows = make_batch(100)
+        out = str(sandbox / "cw")
+        w = DatasetWriter(out, SCHEMA, TFRecordOptions(), mode="overwrite")
+        files = w.write_batches([batch])
+        assert len(files) == 1
+        ds = TFRecordDataset(out, batch_size=100, schema=SCHEMA, drop_remainder=False)
+        with ds.batches() as it:
+            back = next(it)
+        got_rows = batch_to_rows(back, SCHEMA)
+        want_rows = batch_to_rows(batch, SCHEMA)
+        for g, w_ in zip(got_rows, want_rows):
+            for gv, wv, f in zip(g, w_, SCHEMA):
+                if isinstance(wv, float):
+                    assert gv == pytest.approx(wv, abs=1e-6), f.name
+                elif isinstance(wv, list) and wv and isinstance(wv[0], float):
+                    assert gv == pytest.approx(wv, abs=1e-6), f.name
+                else:
+                    assert gv == wv, f.name
+
+    def test_nulls_round_trip_as_masked(self, sandbox):
+        batch, rows = make_batch(50, with_nulls=True)
+        out = str(sandbox / "cwn")
+        DatasetWriter(out, SCHEMA, TFRecordOptions(), mode="overwrite").write_batches([batch])
+        ds = TFRecordDataset(out, batch_size=50, schema=SCHEMA, drop_remainder=False)
+        with ds.batches() as it:
+            back = next(it)
+        np.testing.assert_array_equal(back["d"].mask, batch["d"].mask)
+        assert not back["d"].mask.all()
+
+    def test_native_encode_matches_python_row_path(self, sandbox):
+        """Force the Python fallback in a second write; decoded batches from
+        both files must be identical."""
+        if not _native.available():
+            pytest.skip("native lib unavailable")
+        batch, rows = make_batch(40)
+        out_native = str(sandbox / "nat")
+        DatasetWriter(out_native, SCHEMA, TFRecordOptions(), mode="overwrite").write_batches([batch])
+        out_py = str(sandbox / "py")
+        tfio.write(rows, SCHEMA, out_py, mode="overwrite")
+        a = tfio.read(out_native, schema=SCHEMA).rows
+        b = tfio.read(out_py, schema=SCHEMA).rows
+        assert len(a) == len(b) == 40
+        for ra, rb in zip(a, b):
+            for va, vb in zip(ra, rb):
+                if isinstance(vb, float):
+                    assert va == pytest.approx(vb, abs=1e-6)
+                elif (
+                    isinstance(vb, list) and vb and isinstance(vb[0], float)
+                ):
+                    assert va == pytest.approx(vb, abs=1e-6)
+                elif hasattr(vb, "as_tuple"):  # Decimal
+                    assert float(va) == pytest.approx(float(vb))
+                else:
+                    assert va == vb
+
+    def test_max_records_per_file_rollover(self, sandbox):
+        batch, _ = make_batch(95)
+        out = str(sandbox / "roll")
+        w = DatasetWriter(out, SCHEMA, TFRecordOptions(), mode="overwrite",
+                          max_records_per_file=30)
+        files = w.write_batches([batch])
+        assert len(files) == 4  # 30+30+30+5
+        assert len(tfio.read(out, schema=SCHEMA)) == 95
+
+    def test_gzip_columnar_write(self, sandbox):
+        batch, _ = make_batch(20)
+        out = str(sandbox / "gz")
+        opts = TFRecordOptions.from_map({"codec": "gzip"})
+        files = DatasetWriter(out, SCHEMA, opts, mode="overwrite").write_batches([batch])
+        assert files[0].endswith(".tfrecord.gz")
+        assert len(tfio.read(out, schema=SCHEMA)) == 20
+
+    def test_non_nullable_mask_raises(self, sandbox):
+        schema = StructType([StructField("x", FloatType(), nullable=False)])
+        ser = TFRecordSerializer(StructType([StructField("x", FloatType())]))
+        records = [
+            encode_row(ser, RecordType.EXAMPLE, [1.0]),
+        ]
+        from tpu_tfrecord import proto
+        records.append(proto.encode_example(proto.Example()))  # missing x
+        batch = ColumnarDecoder(StructType([StructField("x", FloatType())])).decode_batch(records)
+        out = str(sandbox / "nn")
+        w = DatasetWriter(out, schema, TFRecordOptions(), mode="overwrite")
+        with pytest.raises(NullValueError):
+            w.write_batches([batch])
+
+    def test_partitioned_write_batches_rejected(self, sandbox):
+        schema = StructType([StructField("x", LongType()), StructField("p", LongType())])
+        w = DatasetWriter(str(sandbox / "p"), schema, TFRecordOptions(),
+                          mode="overwrite", partition_by=["p"])
+        with pytest.raises(ValueError, match="partition_by"):
+            w.write_batches([])
+
+    def test_decimal_column_batch_write(self, sandbox):
+        schema = StructType([StructField("dec", DecimalType())])
+        ser = TFRecordSerializer(schema)
+        import decimal
+
+        records = [encode_row(ser, RecordType.EXAMPLE, [decimal.Decimal("1.5")])]
+        batch = ColumnarDecoder(schema).decode_batch(records)
+        out = str(sandbox / "dec")
+        DatasetWriter(out, schema, TFRecordOptions(), mode="overwrite").write_batches([batch])
+        t = tfio.read(out, schema=schema)
+        assert float(t.rows[0][0]) == 1.5

@@ -1,4 +1,4 @@
-"""Loader + ctypes bindings for the C++ fast path (src/cc/tfrecord_native.cc).
+"""Loader + ctypes bindings for the C++ fast path (csrc/tfrecord_native.cc).
 
 The native library provides hardware CRC32C, TFRecord frame scanning, and
 batch Example/SequenceExample -> columnar decoding (the components the
@@ -40,7 +40,7 @@ from tpu_tfrecord.schema import (
 from tpu_tfrecord.serde import NullValueError
 
 _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(os.path.dirname(_PKG_DIR), "src", "cc", "tfrecord_native.cc")
+_SRC = os.path.join(_PKG_DIR, "csrc", "tfrecord_native.cc")
 _LIB_DIR = os.path.join(_PKG_DIR, "_lib")
 _LIB_PATH = os.path.join(_LIB_DIR, "libtfrecord_native.so")
 
@@ -106,6 +106,15 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.tfr_hash_blob.restype = None
     lib.tfr_hash_blob.argtypes = [
         ctypes.c_char_p, i64p, ctypes.c_int64, ctypes.c_int64, i64p
+    ]
+    lib.tfr_encode_batch.restype = ctypes.c_int64
+    lib.tfr_encode_batch.argtypes = [
+        ctypes.c_int64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_char_p), i64p, i32p, i32p,
+        ctypes.POINTER(u8p), ctypes.POINTER(i64p),
+        ctypes.POINTER(u8p), ctypes.POINTER(i64p),
+        ctypes.POINTER(u8p),
+        u8p, ctypes.c_int64,
     ]
     return lib
 
@@ -345,6 +354,95 @@ def hash_blob(blob: bytes, blob_offsets: np.ndarray, num_buckets: int) -> np.nda
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
     )
     return out
+
+
+class NativeEncoder:
+    """Columnar batch -> framed tf.Example stream, one native call.
+
+    The write-side twin of NativeDecoder (reference write hot loop,
+    TFRecordOutputWriter.scala:26-38, done batch-at-a-time). Ragged2 /
+    SequenceExample stays on the Python path.
+    """
+
+    def __init__(self, schema: StructType):
+        lib = load()
+        if lib is None:
+            raise RuntimeError(f"native library unavailable: {_load_error}")
+        self._lib = lib
+        self.schema = schema
+        n = len(schema)
+        specs = [_field_spec(f.name, f.data_type) for f in schema]
+        if any(s[0] == _LAYOUT_RAGGED2 for s in specs):
+            raise ValueError("array-of-array encode has no native path")
+        self._names = [f.name.encode("utf-8") for f in schema]
+        self._c_names = (ctypes.c_char_p * n)(*self._names)
+        self._name_lens = np.array([len(b) for b in self._names], dtype=np.int64)
+        self._layouts = [s[0] for s in specs]
+        self._kinds = np.array([s[1] for s in specs], dtype=np.int32)
+        self._dtypes = np.array([s[2] for s in specs], dtype=np.int32)
+        self._non_nullable = [not f.nullable for f in schema]
+
+    def encode_batch(self, batch: ColumnarBatch) -> np.ndarray:
+        """Returns a uint8 array holding the framed record stream."""
+        lib = self._lib
+        n_fields = len(self.schema)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        values_arr = (u8p * n_fields)()
+        rowoff_arr = (i64p * n_fields)()
+        blob_arr = (u8p * n_fields)()
+        bloboff_arr = (i64p * n_fields)()
+        mask_arr = (u8p * n_fields)()
+        keepalive = []
+        for i, f in enumerate(self.schema):
+            col = batch[f.name]
+            if col.mask is not None and not col.mask.all():
+                if self._non_nullable[i]:
+                    raise NullValueError(f"{f.name} does not allow null values")
+                m = np.ascontiguousarray(col.mask, dtype=np.uint8)
+                keepalive.append(m)
+                mask_arr[i] = m.ctypes.data_as(u8p)
+            if self._layouts[i] != _LAYOUT_SCALAR:
+                ro = np.ascontiguousarray(col.offsets, dtype=np.int64)
+                keepalive.append(ro)
+                rowoff_arr[i] = ro.ctypes.data_as(i64p)
+            if int(self._dtypes[i]) == _DT_BYTES:
+                blob = col.blob if col.blob is not None else b""
+                keepalive.append(blob)
+                blob_arr[i] = ctypes.cast(ctypes.c_char_p(blob), u8p)
+                bo = np.ascontiguousarray(col.blob_offsets, dtype=np.int64)
+                keepalive.append(bo)
+                bloboff_arr[i] = bo.ctypes.data_as(i64p)
+            else:
+                v = np.ascontiguousarray(col.values, dtype=_DT_NP[int(self._dtypes[i])])
+                keepalive.append(v)
+                values_arr[i] = ctypes.cast(v.ctypes.data_as(ctypes.c_void_p), u8p)
+        args = (
+            batch.num_rows, n_fields, self._c_names,
+            self._name_lens.ctypes.data_as(i64p),
+            self._kinds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            self._dtypes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            values_arr, rowoff_arr, blob_arr, bloboff_arr, mask_arr,
+        )
+        size = lib.tfr_encode_batch(*args, None, 0)
+        if size < 0:
+            raise ValueError(f"native encode sizing failed: {size}")
+        out = np.empty(int(size), dtype=np.uint8)
+        written = lib.tfr_encode_batch(*args, out.ctypes.data_as(u8p), int(size))
+        if written != size:
+            raise ValueError(f"native encode failed: wrote {written} of {size}")
+        return out
+
+
+def make_encoder(schema: StructType, record_type) -> Optional["NativeEncoder"]:
+    """NativeEncoder if supported (Example only), else None."""
+    rt = RecordType.parse(record_type) if not isinstance(record_type, RecordType) else record_type
+    if rt != RecordType.EXAMPLE or not available():
+        return None
+    try:
+        return NativeEncoder(schema)
+    except ValueError:
+        return None
 
 
 def make_decoder(schema: StructType, record_type) -> Optional[NativeDecoder]:

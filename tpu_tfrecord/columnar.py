@@ -351,6 +351,76 @@ class ColumnarDecoder:
 # ---------------------------------------------------------------------------
 
 
+def batch_to_rows(batch: ColumnarBatch, schema: StructType) -> List[list]:
+    """Materialize serde-compatible rows from a columnar batch (the slow,
+    row-oriented view — tests, partitioned writes, small exports)."""
+    import decimal as _decimal
+
+    def scalar_of(dt: DataType, v):
+        if isinstance(dt, DecimalType):
+            return _decimal.Decimal(str(v))
+        if isinstance(dt, (FloatType, DoubleType)):
+            return float(v)
+        return int(v)
+
+    n = batch.num_rows
+    rows: List[list] = [[None] * len(schema) for _ in range(n)]
+    for idx, f in enumerate(schema):
+        col = batch[f.name]
+        dt = f.data_type
+        mask = col.mask
+        if isinstance(dt, ArrayType) and isinstance(dt.element_type, ArrayType):
+            inner_dt = dt.element_type.element_type
+            blobs = col.blobs
+            for r in range(n):
+                if mask is not None and not mask[r]:
+                    continue
+                outer = []
+                for j in range(col.offsets[r], col.offsets[r + 1]):
+                    v0, v1 = int(col.inner_offsets[j]), int(col.inner_offsets[j + 1])
+                    if blobs is not None:
+                        items = blobs[v0:v1]
+                        outer.append(
+                            [b.decode("utf-8") for b in items]
+                            if isinstance(inner_dt, StringType)
+                            else list(items)
+                        )
+                    else:
+                        outer.append([scalar_of(inner_dt, v) for v in col.values[v0:v1]])
+                rows[r][idx] = outer
+        elif isinstance(dt, ArrayType):
+            elem = dt.element_type
+            blobs = col.blobs if col.blob is not None else None
+            for r in range(n):
+                if mask is not None and not mask[r]:
+                    continue
+                v0, v1 = int(col.offsets[r]), int(col.offsets[r + 1])
+                if blobs is not None:
+                    items = blobs[v0:v1]
+                    rows[r][idx] = (
+                        [b.decode("utf-8") for b in items]
+                        if isinstance(elem, StringType)
+                        else list(items)
+                    )
+                else:
+                    rows[r][idx] = [scalar_of(elem, v) for v in col.values[v0:v1]]
+        elif isinstance(dt, (StringType, BinaryType)):
+            blobs = col.blobs
+            for r in range(n):
+                if mask is not None and not mask[r]:
+                    continue
+                rows[r][idx] = (
+                    blobs[r].decode("utf-8") if isinstance(dt, StringType) else blobs[r]
+                )
+        else:
+            vals = col.values
+            for r in range(n):
+                if mask is not None and not mask[r]:
+                    continue
+                rows[r][idx] = scalar_of(dt, vals[r])
+    return rows
+
+
 def _slice_blob(col: Column, new: Column, v0: int, v1: int) -> None:
     bo = col.blob_offsets
     b0, b1 = int(bo[v0]), int(bo[v1])

@@ -738,6 +738,201 @@ int64_t tfr_frame_records(const uint8_t* payloads, const uint64_t* offsets,
   return (int64_t)pos;
 }
 
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Batch encode: columnar buffers -> framed tf.Example records
+// ---------------------------------------------------------------------------
+//
+// The write-side twin of tfr_decode_batch: one call turns a columnar batch
+// (same layouts) into a contiguous stream of framed records. Two-phase API:
+// tfr_encode_batch with out=null returns the exact byte size; a second call
+// fills the caller-allocated buffer (numpy array) and returns bytes written.
+
+namespace {
+
+inline int varint_size(uint64_t v) {
+  int n = 1;
+  while (v >= 0x80) { v >>= 7; n++; }
+  return n;
+}
+
+inline void write_varint(uint8_t*& p, uint64_t v) {
+  while (v >= 0x80) { *p++ = (uint8_t)(v | 0x80); v >>= 7; }
+  *p++ = (uint8_t)v;
+}
+
+struct EncCol {
+  const char* name;
+  size_t name_len;
+  int32_t kind;
+  int32_t dtype;
+  const uint8_t* values;       // typed buffer
+  const int64_t* row_offsets;  // null for scalar
+  const uint8_t* blob;
+  const int64_t* blob_offsets;
+  const uint8_t* mask;         // null = all present
+
+  inline bool present(int64_t r) const { return mask == nullptr || mask[r]; }
+
+  inline void value_range(int64_t r, int64_t* v0, int64_t* v1) const {
+    if (row_offsets) { *v0 = row_offsets[r]; *v1 = row_offsets[r + 1]; }
+    else { *v0 = r; *v1 = r + 1; }
+  }
+
+  // size of the list payload (the packed values / bytes entries)
+  inline uint64_t list_payload_size(int64_t v0, int64_t v1) const {
+    uint64_t sz = 0;
+    if (kind == KIND_INT64) {
+      if (dtype == DT_I64) {
+        const int64_t* p = (const int64_t*)values;
+        for (int64_t i = v0; i < v1; i++) sz += varint_size((uint64_t)p[i]);
+      } else {
+        const int32_t* p = (const int32_t*)values;
+        for (int64_t i = v0; i < v1; i++) sz += varint_size((uint64_t)(int64_t)p[i]);
+      }
+    } else if (kind == KIND_FLOAT) {
+      sz = (uint64_t)(v1 - v0) * 4;
+    } else {
+      for (int64_t i = v0; i < v1; i++) {
+        uint64_t blen = (uint64_t)(blob_offsets[i + 1] - blob_offsets[i]);
+        sz += 1 + varint_size(blen) + blen;  // tag + len + bytes per value
+      }
+    }
+    return sz;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Encode a batch of Examples. If out == nullptr, returns the exact total
+// framed size. Otherwise writes and returns bytes written (-1 if cap too
+// small, -2 on bad input).
+int64_t tfr_encode_batch(
+    int64_t n_records, int32_t n_fields,
+    const char** field_names, const int64_t* name_lens,
+    const int32_t* kinds, const int32_t* dtypes,
+    const uint8_t** values, const int64_t** row_offsets,
+    const uint8_t** blobs, const int64_t** blob_offsets,
+    const uint8_t** masks,
+    uint8_t* out, int64_t cap) {
+  init_crc32c_table();
+  std::vector<EncCol> cols((size_t)n_fields);
+  for (int32_t i = 0; i < n_fields; i++) {
+    cols[i] = EncCol{field_names[i], (size_t)name_lens[i], kinds[i], dtypes[i],
+                     values[i], row_offsets[i], blobs[i], blob_offsets[i], masks[i]};
+  }
+  uint64_t total = 0;
+  uint8_t* p = out;
+  for (int64_t r = 0; r < n_records; r++) {
+    // ---- size pass for this record ----
+    uint64_t features_payload = 0;  // sum of map-entry fields
+    for (int32_t i = 0; i < n_fields; i++) {
+      EncCol& c = cols[i];
+      if (!c.present(r)) continue;
+      int64_t v0, v1;
+      c.value_range(r, &v0, &v1);
+      uint64_t list_payload = c.list_payload_size(v0, v1);
+      // list message (BytesList/FloatList/Int64List): for packed numeric,
+      // payload is wrapped as field 1 LEN; bytes entries are already tagged.
+      uint64_t list_msg = (c.kind == KIND_BYTES)
+                              ? list_payload
+                              : (v1 > v0 ? 1 + varint_size(list_payload) + list_payload : 0);
+      uint64_t feature_msg = 1 + varint_size(list_msg) + list_msg;  // kind tag
+      uint64_t entry = 1 + varint_size(c.name_len) + c.name_len      // key
+                       + 1 + varint_size(feature_msg) + feature_msg; // value
+      features_payload += 1 + varint_size(entry) + entry;            // entry tag
+    }
+    uint64_t example = features_payload
+                           ? 1 + varint_size(features_payload) + features_payload
+                           : 0;
+    uint64_t framed = 16 + example;
+    total += framed;
+    if (out == nullptr) continue;
+    if ((int64_t)(p - out) + (int64_t)framed > cap) return -1;
+
+    // ---- write pass ----
+    uint8_t* rec_start = p;
+    uint64_t ex_len = example;
+    std::memcpy(p, &ex_len, 8);
+    uint32_t lcrc = masked_crc(p, 8);
+    std::memcpy(p + 8, &lcrc, 4);
+    p += 12;
+    uint8_t* data_start = p;
+    if (features_payload) {
+      *p++ = 0x0A;  // Example.features, field 1 LEN
+      write_varint(p, features_payload);
+      for (int32_t i = 0; i < n_fields; i++) {
+        EncCol& c = cols[i];
+        if (!c.present(r)) continue;
+        int64_t v0, v1;
+        c.value_range(r, &v0, &v1);
+        uint64_t list_payload = c.list_payload_size(v0, v1);
+        uint64_t list_msg = (c.kind == KIND_BYTES)
+                                ? list_payload
+                                : (v1 > v0 ? 1 + varint_size(list_payload) + list_payload : 0);
+        uint64_t feature_msg = 1 + varint_size(list_msg) + list_msg;
+        uint64_t entry = 1 + varint_size(c.name_len) + c.name_len
+                         + 1 + varint_size(feature_msg) + feature_msg;
+        *p++ = 0x0A;  // map entry, field 1 LEN
+        write_varint(p, entry);
+        *p++ = 0x0A;  // key, field 1 LEN
+        write_varint(p, c.name_len);
+        std::memcpy(p, c.name, c.name_len);
+        p += c.name_len;
+        *p++ = 0x12;  // value (Feature), field 2 LEN
+        write_varint(p, feature_msg);
+        *p++ = (uint8_t)((c.kind << 3) | 2);  // kind submessage tag
+        write_varint(p, list_msg);
+        if (c.kind == KIND_BYTES) {
+          for (int64_t v = v0; v < v1; v++) {
+            uint64_t blen = (uint64_t)(c.blob_offsets[v + 1] - c.blob_offsets[v]);
+            *p++ = 0x0A;  // value, field 1 LEN
+            write_varint(p, blen);
+            std::memcpy(p, c.blob + c.blob_offsets[v], blen);
+            p += blen;
+          }
+        } else if (v1 > v0) {
+          *p++ = 0x0A;  // packed values, field 1 LEN
+          write_varint(p, list_payload);
+          if (c.kind == KIND_INT64) {
+            if (c.dtype == DT_I64) {
+              const int64_t* vp = (const int64_t*)c.values;
+              for (int64_t v = v0; v < v1; v++) write_varint(p, (uint64_t)vp[v]);
+            } else {
+              const int32_t* vp = (const int32_t*)c.values;
+              for (int64_t v = v0; v < v1; v++) write_varint(p, (uint64_t)(int64_t)vp[v]);
+            }
+          } else {
+            if (c.dtype == DT_F32) {
+              std::memcpy(p, c.values + v0 * 4, (size_t)(v1 - v0) * 4);
+              p += (v1 - v0) * 4;
+            } else {  // f64 -> f32 downcast on the wire
+              const double* vp = (const double*)c.values;
+              for (int64_t v = v0; v < v1; v++) {
+                float f = (float)vp[v];
+                std::memcpy(p, &f, 4);
+                p += 4;
+              }
+            }
+          }
+        }
+      }
+    }
+    uint32_t dcrc = masked_crc(data_start, ex_len);
+    std::memcpy(p, &dcrc, 4);
+    p += 4;
+    if ((uint64_t)(p - rec_start) != framed) return -2;  // size/write mismatch
+  }
+  return out == nullptr ? (int64_t)total : (int64_t)(p - out);
+}
+
+}  // extern "C"
+
+extern "C" {
+
 // CRC32C-hash each value in a blob into [0, num_buckets). The categorical
 // string -> embedding-row path: strings never reach Python objects or the
 // TPU; one call hashes a whole column.

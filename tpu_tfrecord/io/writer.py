@@ -54,6 +54,12 @@ class ShardWriter:
     def write_serialized(self, record: bytes) -> None:
         self._writer.write(record)
 
+    def write_framed(self, framed: "bytes | memoryview", n_records: int) -> None:
+        """Write an already-framed record stream (native encoder output)."""
+        self._fh.write(framed)
+        self._writer.records_written += n_records
+        self._writer.bytes_written += len(framed)
+
     @property
     def records_written(self) -> int:
         return self._writer.records_written
@@ -127,74 +133,34 @@ class DatasetWriter:
         """Write all rows as one logical job; returns final shard paths."""
         if not self._prepare_output():
             return []
-        job = uuid.uuid4().hex[:12]
-        temp_root = os.path.join(self.output_path, p.TEMP_PREFIX, job)
-        os.makedirs(temp_root, exist_ok=True)
-        ext = self.options.file_extension()
+        job = _WriteJob(self, task_id)
         writers: Dict[str, ShardWriter] = {}
-        seq: Dict[str, int] = {}
-        final_of: Dict[str, str] = {}
-        # Shards closed mid-job (max_records_per_file rollover) stay under
-        # _temporary until the single end-of-job commit — a failed job must
-        # leave NOTHING in the final directory.
-        pending_commit: List[str] = []
         try:
             with timed("write", METRICS) as t:
                 for row in rows:
                     rel = self._partition_rel_dir(row)
-                    key = rel
-                    w = writers.get(key)
+                    w = writers.get(rel)
                     if w is not None and (
                         self.max_records_per_file
                         and w.records_written >= self.max_records_per_file
                     ):
-                        w.close()
-                        pending_commit.append(w.path)
+                        job.retire(writers.pop(rel))
                         w = None
-                        writers.pop(key)
                     if w is None:
-                        n = seq.get(key, 0)
-                        seq[key] = n + 1
-                        fname = p.new_shard_filename(task_id, f".c{n:03d}{ext}", job)
-                        tmp_dir = os.path.join(temp_root, rel) if rel else temp_root
-                        os.makedirs(tmp_dir, exist_ok=True)
-                        tmp_path = os.path.join(tmp_dir, fname)
-                        final_dir = (
-                            os.path.join(self.output_path, rel)
-                            if rel
-                            else self.output_path
-                        )
-                        final_of[tmp_path] = os.path.join(final_dir, fname)
-                        w = writers[key] = ShardWriter(
-                            tmp_path, self.data_schema, self.options
-                        )
+                        w = writers[rel] = job.new_shard(rel)
                     w.write(self._strip_partitions(row))
                     t.records += 1
             for w in writers.values():
-                w.close()
-                pending_commit.append(w.path)
-            written = []
-            for tmp_path in pending_commit:
-                self._commit_shard(tmp_path, final_of[tmp_path])
-                written.append(final_of[tmp_path])
+                job.retire(w)
         except Exception:
             for w in writers.values():
                 try:
                     w.close()
                 except Exception:
                     pass
-            # Remove only THIS job's temp dir: other concurrent tasks may
-            # have jobs in flight under the shared _temporary root.
-            shutil.rmtree(temp_root, ignore_errors=True)
+            job.abort()
             raise
-        shutil.rmtree(temp_root, ignore_errors=True)
-        temp_parent = os.path.join(self.output_path, p.TEMP_PREFIX)
-        try:
-            os.rmdir(temp_parent)  # only if no other job is using it
-        except OSError:
-            pass
-        p.write_success_marker(self.output_path)
-        return written
+        return job.commit()
 
     def _partition_rel_dir(self, row: Sequence[Any]) -> str:
         if not self.partition_by:
@@ -211,6 +177,126 @@ class DatasetWriter:
         """Idempotent shard commit: atomic rename into place."""
         os.makedirs(os.path.dirname(final_path), exist_ok=True)
         os.replace(tmp_path, final_path)
+
+    def write_batches(self, batches, task_id: int = 0) -> List[str]:
+        """Write ColumnarBatches (the fast columnar path; Example only,
+        non-partitioned). See module docstring for save-mode semantics."""
+        return _write_batches(self, batches, task_id)
+
+
+class _WriteJob:
+    """Shared scaffolding for one logical write job: a job-scoped temp dir
+    under ``_temporary/<job>/``, shard allocation, and the single end-of-job
+    commit (rename into place + ``_SUCCESS``). A failed job leaves NOTHING in
+    the final directory and never touches other jobs' temp dirs."""
+
+    def __init__(self, writer: "DatasetWriter", task_id: int):
+        self.writer = writer
+        self.task_id = task_id
+        self.job_id = uuid.uuid4().hex[:12]
+        self.temp_root = os.path.join(writer.output_path, p.TEMP_PREFIX, self.job_id)
+        os.makedirs(self.temp_root, exist_ok=True)
+        self.ext = writer.options.file_extension()
+        self._seq: Dict[str, int] = {}
+        self._final_of: Dict[str, str] = {}
+        self._pending: List[str] = []
+
+    def new_shard(self, rel: str = "") -> ShardWriter:
+        n = self._seq.get(rel, 0)
+        self._seq[rel] = n + 1
+        fname = p.new_shard_filename(self.task_id, f".c{n:03d}{self.ext}", self.job_id)
+        tmp_dir = os.path.join(self.temp_root, rel) if rel else self.temp_root
+        os.makedirs(tmp_dir, exist_ok=True)
+        tmp_path = os.path.join(tmp_dir, fname)
+        final_dir = (
+            os.path.join(self.writer.output_path, rel)
+            if rel
+            else self.writer.output_path
+        )
+        self._final_of[tmp_path] = os.path.join(final_dir, fname)
+        return ShardWriter(tmp_path, self.writer.data_schema, self.writer.options)
+
+    def retire(self, shard_writer: ShardWriter) -> None:
+        """Close a finished shard; it stays in temp until commit()."""
+        shard_writer.close()
+        self._pending.append(shard_writer.path)
+
+    def commit(self) -> List[str]:
+        written = []
+        for tmp_path in self._pending:
+            self.writer._commit_shard(tmp_path, self._final_of[tmp_path])
+            written.append(self._final_of[tmp_path])
+        shutil.rmtree(self.temp_root, ignore_errors=True)
+        try:
+            # only removable once no other job is using the shared parent
+            os.rmdir(os.path.join(self.writer.output_path, p.TEMP_PREFIX))
+        except OSError:
+            pass
+        p.write_success_marker(self.writer.output_path)
+        return written
+
+    def abort(self) -> None:
+        shutil.rmtree(self.temp_root, ignore_errors=True)
+
+
+def _write_batches(
+    writer: "DatasetWriter", batches, task_id: int = 0
+) -> List[str]:
+    """Columnar write job: one native encode call per batch (the fast write
+    path; falls back to per-row encoding when the schema has no native
+    encoder). Non-partitioned only — partitionBy routes per row."""
+    from tpu_tfrecord import _native
+    from tpu_tfrecord.columnar import batch_to_rows, slice_batch
+
+    if writer.partition_by:
+        raise ValueError("write_batches does not support partition_by; use rows")
+    if not writer._prepare_output():
+        return []
+    job = _WriteJob(writer, task_id)
+    encoder = _native.make_encoder(writer.data_schema, writer.options.record_type)
+    max_per_file = writer.max_records_per_file
+    current: Optional[ShardWriter] = None
+    try:
+        with timed("write", METRICS) as t:
+            for batch in batches:
+                pos = 0
+                while pos < batch.num_rows:
+                    if current is None:
+                        current = job.new_shard()
+                    room = (
+                        max_per_file - current.records_written
+                        if max_per_file
+                        else batch.num_rows - pos
+                    )
+                    take = min(room, batch.num_rows - pos)
+                    part = (
+                        batch
+                        if (pos == 0 and take == batch.num_rows)
+                        else slice_batch(batch, pos, pos + take)
+                    )
+                    if encoder is not None:
+                        framed = encoder.encode_batch(part)
+                        # zero-copy view; file objects accept any buffer
+                        current.write_framed(framed.data, part.num_rows)
+                    else:
+                        for row in batch_to_rows(part, writer.data_schema):
+                            current.write(row)
+                    t.records += part.num_rows
+                    pos += take
+                    if max_per_file and current.records_written >= max_per_file:
+                        job.retire(current)
+                        current = None
+        if current is not None:
+            job.retire(current)
+    except Exception:
+        if current is not None:
+            try:
+                current.close()
+            except Exception:
+                pass
+        job.abort()
+        raise
+    return job.commit()
 
 
 def write_dataset(
