@@ -1,0 +1,63 @@
+"""Tests for checkpoint persistence of iterator state."""
+
+import os
+
+import pytest
+
+import tpu_tfrecord.io as tfio
+from tpu_tfrecord import checkpoint
+from tpu_tfrecord.io.dataset import IteratorState, TFRecordDataset
+from tpu_tfrecord.schema import LongType, StructField, StructType
+
+SCHEMA = StructType([StructField("uid", LongType())])
+
+
+def test_save_load_round_trip(tmp_path):
+    st = IteratorState(epoch=2, shard_cursor=5, record_offset=77)
+    path = checkpoint.save_state(str(tmp_path), st, process_index=3, step=42)
+    assert os.path.basename(path) == "_input_state.3.json"
+    assert checkpoint.load_state(str(tmp_path), process_index=3) == st
+    assert checkpoint.load_state(str(tmp_path), process_index=9) is None
+
+
+def test_save_from_live_iterator_and_resume(sandbox, tmp_path):
+    out = str(sandbox / "ds")
+    for s in range(3):
+        tfio.write([[s * 10 + i] for i in range(6)], SCHEMA, out, mode="append")
+    full = []
+    ds = TFRecordDataset(out, batch_size=6, schema=SCHEMA)
+    with ds.batches() as it:
+        for b in it:
+            full.extend(b["uid"].values.tolist())
+
+    ds1 = TFRecordDataset(out, batch_size=6, schema=SCHEMA)
+    with ds1.batches() as it:
+        first = next(it)["uid"].values.tolist()
+        checkpoint.save_state(str(tmp_path), it, process_index=0)
+    st = checkpoint.load_state(str(tmp_path), process_index=0)
+    rest = []
+    ds2 = TFRecordDataset(out, batch_size=6, schema=SCHEMA)
+    with ds2.batches(st) as it:
+        for b in it:
+            rest.extend(b["uid"].values.tolist())
+    assert first + rest == full
+
+
+def test_state_file_inside_dataset_dir_is_ignored_by_discovery(sandbox):
+    out = str(sandbox / "ds2")
+    tfio.write([[1], [2]], SCHEMA, out, mode="overwrite")
+    checkpoint.save_state(out, IteratorState(), process_index=0)
+    shards = tfio.discover_shards(out)
+    assert all("input_state" not in s.path for s in shards)
+    assert len(tfio.read(out, schema=SCHEMA)) == 2
+
+
+def test_version_check(tmp_path):
+    import json
+
+    path = checkpoint.state_path(str(tmp_path), 0)
+    os.makedirs(str(tmp_path), exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump({"version": 999, "state": {}}, fh)
+    with pytest.raises(ValueError, match="version"):
+        checkpoint.load_state(str(tmp_path), process_index=0)

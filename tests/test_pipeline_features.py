@@ -1,0 +1,180 @@
+"""Tests for pipeline features: multi-worker decode, shard shuffle, retries."""
+
+import os
+
+import numpy as np
+import pytest
+
+import tpu_tfrecord.io as tfio
+from tpu_tfrecord.io.dataset import IteratorState, TFRecordDataset
+from tpu_tfrecord.schema import FloatType, LongType, StructField, StructType
+
+SCHEMA = StructType([StructField("uid", LongType()), StructField("v", FloatType())])
+
+
+def write_shards(sandbox, num_shards=6, rows_per_shard=7):
+    out = str(sandbox / "pf")
+    uid = 0
+    for s in range(num_shards):
+        tfio.write(
+            [[uid + i, float(uid + i)] for i in range(rows_per_shard)],
+            SCHEMA,
+            out,
+            mode="append",
+        )
+        uid += rows_per_shard
+    return out
+
+
+def collect_uids(ds, state=None):
+    uids = []
+    with ds.batches(state) as it:
+        for b in it:
+            uids.extend(b["uid"].values.tolist())
+    return uids
+
+
+class TestMultiWorker:
+    @pytest.mark.parametrize("workers", [2, 4])
+    def test_identical_to_sequential(self, sandbox, workers):
+        out = write_shards(sandbox)
+        seq = collect_uids(TFRecordDataset(out, batch_size=5, schema=SCHEMA))
+        par = collect_uids(
+            TFRecordDataset(out, batch_size=5, schema=SCHEMA, num_workers=workers)
+        )
+        assert par == seq  # exact order, not just same multiset
+
+    def test_parallel_resume(self, sandbox):
+        out = write_shards(sandbox)
+        ds = TFRecordDataset(out, batch_size=5, schema=SCHEMA, num_workers=3)
+        with ds.batches() as it:
+            first = next(it)["uid"].values.tolist()
+            st = it.state()
+        rest = collect_uids(
+            TFRecordDataset(out, batch_size=5, schema=SCHEMA, num_workers=3), st
+        )
+        seq_all = collect_uids(TFRecordDataset(out, batch_size=5, schema=SCHEMA))
+        assert first + rest == seq_all
+
+    def test_parallel_error_propagates(self, sandbox):
+        out = write_shards(sandbox, num_shards=2)
+        f = sorted(
+            os.path.join(out, x) for x in os.listdir(out) if x.endswith(".tfrecord")
+        )[1]
+        raw = bytearray(open(f, "rb").read())
+        raw[20] ^= 0xFF
+        open(f, "wb").write(bytes(raw))
+        ds = TFRecordDataset(out, batch_size=4, schema=SCHEMA, num_workers=2)
+        with pytest.raises(Exception):
+            collect_uids(ds)
+
+
+class TestShuffle:
+    def test_shuffle_is_permutation_and_seeded(self, sandbox):
+        out = write_shards(sandbox)
+        base = collect_uids(
+            TFRecordDataset(out, batch_size=7, schema=SCHEMA, drop_remainder=False)
+        )
+        s1 = collect_uids(
+            TFRecordDataset(out, batch_size=7, schema=SCHEMA, shuffle=True, seed=1,
+                            drop_remainder=False)
+        )
+        s1b = collect_uids(
+            TFRecordDataset(out, batch_size=7, schema=SCHEMA, shuffle=True, seed=1,
+                            drop_remainder=False)
+        )
+        s2 = collect_uids(
+            TFRecordDataset(out, batch_size=7, schema=SCHEMA, shuffle=True, seed=2,
+                            drop_remainder=False)
+        )
+        assert sorted(s1) == sorted(base)
+        assert s1 == s1b           # deterministic for a seed
+        assert s1 != base or s2 != base  # actually shuffles
+
+    def test_epochs_reshuffle(self, sandbox):
+        out = write_shards(sandbox)
+        ds = TFRecordDataset(out, batch_size=42, schema=SCHEMA, shuffle=True, seed=3,
+                             num_epochs=2, drop_remainder=False)
+        uids = collect_uids(ds)
+        e1, e2 = uids[:42], uids[42:]
+        assert sorted(e1) == sorted(e2)
+        assert e1 != e2  # different epoch permutation
+
+    def test_shuffled_resume_matches_uninterrupted(self, sandbox):
+        out = write_shards(sandbox)
+        full = collect_uids(
+            TFRecordDataset(out, batch_size=5, schema=SCHEMA, shuffle=True, seed=7)
+        )
+        ds = TFRecordDataset(out, batch_size=5, schema=SCHEMA, shuffle=True, seed=7)
+        with ds.batches() as it:
+            first = next(it)["uid"].values.tolist()
+            st = it.state()
+        rest = collect_uids(
+            TFRecordDataset(out, batch_size=5, schema=SCHEMA, shuffle=True, seed=7), st
+        )
+        assert first + rest == full
+
+    def test_shuffle_with_workers(self, sandbox):
+        out = write_shards(sandbox)
+        a = collect_uids(
+            TFRecordDataset(out, batch_size=5, schema=SCHEMA, shuffle=True, seed=5)
+        )
+        b = collect_uids(
+            TFRecordDataset(out, batch_size=5, schema=SCHEMA, shuffle=True, seed=5,
+                            num_workers=3)
+        )
+        assert a == b
+
+
+class TestRetries:
+    def test_transient_io_error_retried(self, sandbox, monkeypatch):
+        out = write_shards(sandbox, num_shards=1)
+        ds = TFRecordDataset(out, batch_size=7, schema=SCHEMA, read_retries=2,
+                             drop_remainder=False)
+        real_open = __import__("tpu_tfrecord.wire", fromlist=["wire"]).open_compressed
+        calls = {"n": 0}
+
+        def flaky(path, mode, codec):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("transient network blip")
+            return real_open(path, mode, codec)
+
+        monkeypatch.setattr("tpu_tfrecord.wire.open_compressed", flaky)
+        uids = collect_uids(ds)
+        assert len(uids) == 7
+        assert calls["n"] == 2
+
+    def test_exhausted_retries_raise(self, sandbox, monkeypatch):
+        out = write_shards(sandbox, num_shards=1)
+        ds = TFRecordDataset(out, batch_size=7, schema=SCHEMA, read_retries=1)
+
+        def always_fail(path, mode, codec):
+            raise OSError("gone")
+
+        monkeypatch.setattr("tpu_tfrecord.wire.open_compressed", always_fail)
+        with pytest.raises(OSError):
+            collect_uids(ds)
+
+
+class TestAbandonedIterator:
+    def test_threads_exit_after_gc_without_close(self, sandbox):
+        """Review regression: dropping an iterator without close() must not
+        leak pipeline threads or pin shard buffers forever."""
+        import gc
+        import threading
+        import time as _time
+
+        out = write_shards(sandbox, num_shards=6, rows_per_shard=20)
+        before = threading.active_count()
+        ds = TFRecordDataset(out, batch_size=5, schema=SCHEMA, num_workers=3,
+                             num_epochs=None)
+        it = ds.batches()
+        next(it)  # pipeline running
+        assert threading.active_count() > before
+        del it
+        gc.collect()
+        deadline = _time.time() + 5
+        while threading.active_count() > before and _time.time() < deadline:
+            _time.sleep(0.1)
+        assert threading.active_count() <= before + 1  # poll-loop grace

@@ -1,0 +1,73 @@
+"""Checkpoint persistence for dataset iterator state.
+
+The reference has no resumability beyond the ``_SUCCESS`` marker (SURVEY.md
+§5 checkpoint/resume: ABSENT). Here the iterator's O(1) state (epoch, shard
+position, record offset — io/dataset.py) persists as a small JSON file per
+process, written atomically, so a training job can bundle it with its model
+checkpoint (e.g. alongside an orbax step directory) and resume mid-epoch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from tpu_tfrecord.io.dataset import CheckpointableIterator, IteratorState
+
+_FORMAT_VERSION = 1
+
+
+def state_path(directory: str, process_index: Optional[int] = None) -> str:
+    """Per-process state file ('_input_state.<pid>.json'): every host owns
+    its own position, mirroring the per-host shard assignment."""
+    if process_index is None:
+        try:
+            import jax
+
+            process_index = jax.process_index()
+        except Exception:
+            process_index = 0
+    # "_"-prefixed like _SUCCESS: shard discovery treats it as metadata, so a
+    # state file inside a dataset directory can never be read as a shard.
+    return os.path.join(directory, f"_input_state.{process_index}.json")
+
+
+def save_state(
+    directory: str,
+    state_or_iterator,
+    process_index: Optional[int] = None,
+    step: Optional[int] = None,
+) -> str:
+    """Atomically persist iterator state; returns the file path."""
+    state = (
+        state_or_iterator.state()
+        if isinstance(state_or_iterator, CheckpointableIterator)
+        else state_or_iterator
+    )
+    os.makedirs(directory, exist_ok=True)
+    path = state_path(directory, process_index)
+    payload = {"version": _FORMAT_VERSION, "state": state.to_json()}
+    if step is not None:
+        payload["step"] = step
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh)
+    os.replace(tmp, path)
+    return path
+
+
+def load_state(
+    directory: str, process_index: Optional[int] = None
+) -> Optional[IteratorState]:
+    """Load this process's saved state; None if no checkpoint exists."""
+    path = state_path(directory, process_index)
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        payload = json.load(fh)
+    if payload.get("version") != _FORMAT_VERSION:
+        raise ValueError(
+            f"unsupported input-state version {payload.get('version')} at {path}"
+        )
+    return IteratorState.from_json(payload["state"])

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
+import weakref
 from dataclasses import asdict, dataclass
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
@@ -40,9 +42,10 @@ from tpu_tfrecord.schema import StructType
 
 @dataclass(frozen=True)
 class IteratorState:
-    """Grain-style resumable position. ``shard_cursor`` indexes THIS HOST's
-    assigned shard list; ``record_offset`` counts records already consumed
-    from that shard."""
+    """Grain-style resumable position. ``shard_cursor`` is the POSITION in
+    the epoch's iteration order over this host's shard list (identity order,
+    or the (seed, epoch)-derived permutation when shuffling);
+    ``record_offset`` counts records already consumed from that shard."""
 
     epoch: int = 0
     shard_cursor: int = 0
@@ -75,6 +78,10 @@ class TFRecordDataset:
         process_index: int = 0,
         process_count: int = 1,
         prefetch: int = 2,
+        num_workers: int = 1,
+        shuffle: bool = False,
+        seed: int = 0,
+        read_retries: int = 0,
         **option_kwargs: Any,
     ):
         self._reader = (
@@ -104,6 +111,10 @@ class TFRecordDataset:
         self._native_decoder = _native.make_decoder(
             self._data_schema, self.options.record_type
         )
+        self.num_workers = max(1, num_workers)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.read_retries = read_retries
 
     # -- chunked decode stream with positional accounting --------------------
     #
@@ -121,47 +132,82 @@ class TFRecordDataset:
         return self._decoder.decode_batch(records)
 
     def _shard_spans(self, shard) -> tuple:
-        """Load one shard fully and return (buf, offsets, lengths)."""
-        codec = wire.codec_from_path(shard.path)
-        with wire.open_compressed(shard.path, "rb", codec) as fh:
-            buf = fh.read()
-        if not buf:
-            return buf, np.empty(0, np.uint64), np.empty(0, np.uint64)
-        if _native.available():
-            return (buf, *_native.scan(buf, self.options.verify_crc))
-        spans = list(wire.scan_buffer(buf, self.options.verify_crc))
-        offsets = np.array([s for s, _ in spans], dtype=np.uint64)
-        lengths = np.array([l for _, l in spans], dtype=np.uint64)
-        return buf, offsets, lengths
+        """Load one shard fully and return (buf, offsets, lengths), with
+        shard-level retry for transient IO/corruption failures (SURVEY.md §5
+        failure-handling plan; the reference leans on Spark task retry)."""
+        attempt = 0
+        while True:
+            try:
+                codec = wire.codec_from_path(shard.path)
+                with wire.open_compressed(shard.path, "rb", codec) as fh:
+                    buf = fh.read()
+                if not buf:
+                    return buf, np.empty(0, np.uint64), np.empty(0, np.uint64)
+                if _native.available():
+                    return (buf, *_native.scan(buf, self.options.verify_crc))
+                spans = list(wire.scan_buffer(buf, self.options.verify_crc))
+                offsets = np.array([s for s, _ in spans], dtype=np.uint64)
+                lengths = np.array([l for _, l in spans], dtype=np.uint64)
+                return buf, offsets, lengths
+            except (OSError, wire.TFRecordCorruptionError):
+                attempt += 1
+                if attempt > self.read_retries:
+                    raise
+                time.sleep(min(0.1 * 2**attempt, 2.0))
 
-    def _chunk_stream(self, state: IteratorState) -> Iterator[tuple]:
-        """Yield (chunk: ColumnarBatch, epoch, cursor, start_offset) from the
-        resume point onward, across epochs."""
-        chunk_records = max(self.batch_size, 2048)
+    def epoch_order(self, epoch: int) -> List[int]:
+        """Iteration order over this host's shard list for one epoch.
+
+        With ``shuffle`` the order is a permutation derived purely from
+        (seed, epoch): every host and every resume reconstructs it without
+        coordination or stored state.
+        """
+        if not self.shuffle:
+            return list(range(len(self.shards)))
+        rng = np.random.default_rng((self.seed, epoch))
+        return rng.permutation(len(self.shards)).tolist()
+
+    def _shard_tasks(self, state: IteratorState) -> Iterator[tuple]:
+        """Enumerate (epoch, position, shard_index, skip) from the resume
+        point, in the deterministic per-epoch iteration order."""
         epoch = state.epoch
         while self.num_epochs is None or epoch < self.num_epochs:
-            start_cursor = state.shard_cursor if epoch == state.epoch else 0
-            for cursor in range(start_cursor, len(self.shards)):
-                shard = self.shards[cursor]
+            order = self.epoch_order(epoch)
+            start_pos = state.shard_cursor if epoch == state.epoch else 0
+            for pos in range(start_pos, len(order)):
                 skip = (
                     state.record_offset
-                    if (epoch == state.epoch and cursor == state.shard_cursor)
+                    if (epoch == state.epoch and pos == state.shard_cursor)
                     else 0
                 )
-                buf, offsets, lengths = self._shard_spans(shard)
-                n = len(offsets)
-                for start in range(skip, n, chunk_records):
-                    stop = min(start + chunk_records, n)
-                    with timed("decode", METRICS) as t:
-                        chunk = self._decode_chunk(
-                            buf, offsets[start:stop], lengths[start:stop]
-                        )
-                        t.records += chunk.num_rows
-                        t.bytes += int(lengths[start:stop].sum())
-                    if self._partition_fields:
-                        self._attach_partition_chunk(chunk, cursor)
-                    yield chunk, epoch, cursor, start
+                yield epoch, pos, order[pos], skip
             epoch += 1
+
+    def _decode_shard(self, epoch: int, pos: int, shard_idx: int, skip: int) -> Iterator[tuple]:
+        """Decode one shard into chunk tuples (chunk, epoch, pos, start)."""
+        chunk_records = max(self.batch_size, 2048)
+        buf, offsets, lengths = self._shard_spans(self.shards[shard_idx])
+        n = len(offsets)
+        for start in range(skip, n, chunk_records):
+            stop = min(start + chunk_records, n)
+            with timed("decode", METRICS) as t:
+                chunk = self._decode_chunk(buf, offsets[start:stop], lengths[start:stop])
+                t.records += chunk.num_rows
+                t.bytes += int(lengths[start:stop].sum())
+            if self._partition_fields:
+                self._attach_partition_chunk(chunk, shard_idx)
+            yield chunk, epoch, pos, start
+
+    def _chunk_stream(self, state: IteratorState, stop_event=None) -> Iterator[tuple]:
+        """Yield (chunk, epoch, position, start_offset) from the resume point
+        onward. With ``num_workers > 1`` shards decode in a thread pool (the
+        native decoder releases the GIL) and chunks are re-emitted in exact
+        stream order; memory is bounded by num_workers in-flight shards."""
+        if self.num_workers <= 1:
+            for epoch, pos, shard_idx, skip in self._shard_tasks(state):
+                yield from self._decode_shard(epoch, pos, shard_idx, skip)
+            return
+        yield from _parallel_chunks(self, state, stop_event or threading.Event())
 
     def _attach_partition_chunk(self, chunk: ColumnarBatch, cursor: int) -> None:
         """Partition values are constant within a shard: materialize them as
@@ -195,6 +241,165 @@ class TFRecordDataset:
         return CheckpointableIterator(self, state or IteratorState())
 
 
+def _producer_loop(
+    ds: TFRecordDataset,
+    start: IteratorState,
+    out_queue: queue.Queue,
+    stop: threading.Event,
+) -> None:
+    """Background batch producer (module-level so the thread never pins the
+    consumer-side iterator object)."""
+    B = ds.batch_size
+
+    def emit_from(pending: List[list], n: int) -> bool:
+        """Assemble a batch of n rows from the front of the pending chunks;
+        the resume state is the position after the batch's last row."""
+        slices = []
+        need = n
+        end_pos = start
+        while need:
+            entry = pending[0]
+            chunk, consumed, epoch, cursor, chunk_start = entry
+            take = min(need, chunk.num_rows - consumed)
+            slices.append(slice_batch(chunk, consumed, consumed + take))
+            entry[1] = consumed + take
+            need -= take
+            end_pos = IteratorState(epoch, cursor, chunk_start + entry[1])
+            if entry[1] >= chunk.num_rows:
+                pending.pop(0)
+        batch = concat_batches(slices)
+        while not stop.is_set():
+            try:
+                out_queue.put((batch, end_pos), timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    try:
+        # pending: [chunk, consumed_rows, epoch, cursor, chunk_start]
+        pending: List[list] = []
+        avail = 0
+        for chunk, epoch, cursor, chunk_start in ds._chunk_stream(start, stop):
+            if stop.is_set():
+                return
+            if chunk.num_rows == 0:
+                continue
+            pending.append([chunk, 0, epoch, cursor, chunk_start])
+            avail += chunk.num_rows
+            while avail >= B:
+                if not emit_from(pending, B):
+                    return
+                avail -= B
+        if avail and not ds.drop_remainder:
+            emit_from(pending, avail)
+        _put_until_stopped(out_queue, None, stop)
+    except BaseException as e:  # propagate to consumer
+        _put_until_stopped(out_queue, e, stop)
+
+
+def _put_until_stopped(q: queue.Queue, item, stop: threading.Event) -> None:
+    """Enqueue without blocking forever on an abandoned consumer."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return
+        except queue.Full:
+            continue
+
+
+class _ShardJob:
+    """One shard's decode job in the parallel pipeline: a bounded output
+    queue written by a worker, drained in stream order by the emitter."""
+
+    __slots__ = ("task", "out")
+
+    def __init__(self, task: tuple, depth: int):
+        self.task = task
+        self.out: queue.Queue = queue.Queue(maxsize=depth)
+
+
+def _parallel_chunks(
+    ds: TFRecordDataset, state: IteratorState, stop: threading.Event
+) -> Iterator[tuple]:
+    """Ordered parallel shard decode.
+
+    A dispatcher enumerates shard tasks lazily (epochs may be infinite) and
+    hands each to the worker pool; every task owns a small bounded queue, so
+    backpressure is per shard and total buffering is bounded by
+    ``num_workers`` in-flight shards. The emitter drains task queues in the
+    exact task order, so output is identical to the sequential stream —
+    checkpoint state and batch contents do not depend on num_workers.
+    """
+    n_workers = ds.num_workers
+    task_q: queue.Queue = queue.Queue(maxsize=n_workers)
+    order_q: queue.Queue = queue.Queue(maxsize=n_workers + 1)
+    END = object()
+
+    def put_checked(q: queue.Queue, item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def dispatcher() -> None:
+        try:
+            for task in ds._shard_tasks(state):
+                job = _ShardJob(task, depth=2)
+                if not put_checked(order_q, job):
+                    return
+                if not put_checked(task_q, job):
+                    return
+            put_checked(order_q, END)
+        finally:
+            for _ in range(n_workers):
+                if not put_checked(task_q, END):
+                    break
+
+    def worker() -> None:
+        while not stop.is_set():
+            try:
+                job = task_q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if job is END:
+                return
+            try:
+                for item in ds._decode_shard(*job.task):
+                    if not put_checked(job.out, ("chunk", item)):
+                        return
+                put_checked(job.out, ("end", None))
+            except BaseException as e:
+                put_checked(job.out, ("error", e))
+                return
+
+    threads = [threading.Thread(target=dispatcher, daemon=True)]
+    threads += [threading.Thread(target=worker, daemon=True) for _ in range(n_workers)]
+    for t in threads:
+        t.start()
+
+    while not stop.is_set():
+        try:
+            job = order_q.get(timeout=0.1)
+        except queue.Empty:
+            continue
+        if job is END:
+            return
+        while not stop.is_set():
+            try:
+                kind, payload = job.out.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if kind == "end":
+                break
+            if kind == "error":
+                raise payload
+            yield payload
+
+
 class CheckpointableIterator:
     """Iterator of ColumnarBatch with a live, resumable ``state``.
 
@@ -210,57 +415,19 @@ class CheckpointableIterator:
         self._finished = None  # None=running, True=exhausted, Exception=failed
         self._queue: queue.Queue = queue.Queue(maxsize=max(1, dataset.prefetch))
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._producer, daemon=True)
+        # If the iterator is abandoned without close() (no with-block, early
+        # break, GC after an error), the finalizer trips the stop event so
+        # producer/dispatcher/worker threads exit and shard buffers free.
+        # The producer is a module-level function, not a bound method: the
+        # thread must hold no reference to this object, or GC could never
+        # collect an abandoned iterator and the finalizer would never fire.
+        self._finalizer = weakref.finalize(self, self._stop.set)
+        self._thread = threading.Thread(
+            target=_producer_loop,
+            args=(dataset, state, self._queue, self._stop),
+            daemon=True,
+        )
         self._thread.start()
-
-    def _producer(self) -> None:
-        ds = self._ds
-        B = ds.batch_size
-        try:
-            # pending: [chunk, consumed_rows, epoch, cursor, chunk_start]
-            pending: List[list] = []
-            avail = 0
-            for chunk, epoch, cursor, chunk_start in ds._chunk_stream(self._start):
-                if self._stop.is_set():
-                    return
-                if chunk.num_rows == 0:
-                    continue
-                pending.append([chunk, 0, epoch, cursor, chunk_start])
-                avail += chunk.num_rows
-                while avail >= B:
-                    if not self._emit_from(pending, B):
-                        return
-                    avail -= B
-            if avail and not ds.drop_remainder:
-                self._emit_from(pending, avail)
-            self._queue.put(None)
-        except BaseException as e:  # propagate to consumer
-            self._queue.put(e)
-
-    def _emit_from(self, pending: List[list], n: int) -> bool:
-        """Assemble a batch of n rows from the front of the pending chunks;
-        the resume state is the position after the batch's last row."""
-        slices = []
-        need = n
-        end_pos = self._start
-        while need:
-            entry = pending[0]
-            chunk, consumed, epoch, cursor, chunk_start = entry
-            take = min(need, chunk.num_rows - consumed)
-            slices.append(slice_batch(chunk, consumed, consumed + take))
-            entry[1] = consumed + take
-            need -= take
-            end_pos = IteratorState(epoch, cursor, chunk_start + entry[1])
-            if entry[1] >= chunk.num_rows:
-                pending.pop(0)
-        batch = concat_batches(slices)
-        while not self._stop.is_set():
-            try:
-                self._queue.put((batch, end_pos), timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
 
     def __iter__(self) -> "CheckpointableIterator":
         return self
@@ -271,9 +438,11 @@ class CheckpointableIterator:
         item = self._queue.get()
         if item is None:
             self._finished = True
+            self._stop.set()  # let any lingering pipeline threads exit
             raise StopIteration
         if isinstance(item, BaseException):
             self._finished = item
+            self._stop.set()
             raise item
         batch, end_pos = item
         self._consumed_state = end_pos
