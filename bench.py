@@ -89,7 +89,12 @@ def main() -> None:
 
     mesh = create_mesh()  # all available devices on the 'data' axis
     ds = TFRecordDataset(
-        data_dir, batch_size=BATCH_SIZE, schema=schema, num_epochs=None, prefetch=4
+        data_dir,
+        batch_size=BATCH_SIZE,
+        schema=schema,
+        num_epochs=None,
+        prefetch=4,
+        hash_buckets=hash_buckets,  # fused into native decode
     )
 
     pack = {

@@ -270,3 +270,106 @@ class TestReviewRegressions:
             ColumnarDecoder(schema, RecordType.SEQUENCE_EXAMPLE).decode_batch([rec])
         with pytest.raises(ValueError, match="empty inner"):
             _native.NativeDecoder(schema, RecordType.SEQUENCE_EXAMPLE).decode_batch([rec])
+
+
+class TestFusedHashing:
+    """hash_buckets fused into decode: bytes columns emerge as int32."""
+
+    def test_matches_post_hoc_hashing(self):
+        from tpu_tfrecord.tpu.ingest import hash_bytes_column
+
+        schema = StructType([StructField("c", StringType()), StructField("x", LongType())])
+        recs = [
+            encode_example(Example(features={
+                "c": Feature.bytes_list([f"cat-{k % 5}".encode()]),
+                "x": Feature.int64_list([k]),
+            }))
+            for k in range(40)
+        ]
+        plain = _native.NativeDecoder(schema).decode_batch(recs)
+        want = hash_bytes_column(plain["c"], 97)
+        fused = _native.NativeDecoder(schema, hash_buckets={"c": 97}).decode_batch(recs)
+        assert fused["c"].values.dtype == np.int32
+        assert fused["c"].blob is None
+        np.testing.assert_array_equal(fused["c"].values, want)
+        np.testing.assert_array_equal(fused["x"].values, plain["x"].values)
+
+    def test_missing_hashed_column_masks_zero(self):
+        schema = StructType([StructField("c", StringType())])
+        recs = [encode_example(Example())]
+        fused = _native.NativeDecoder(schema, hash_buckets={"c": 8}).decode_batch(recs)
+        np.testing.assert_array_equal(fused["c"].mask, [False])
+        np.testing.assert_array_equal(fused["c"].values, [0])
+
+    def test_hashing_non_bytes_column_rejected(self):
+        schema = StructType([StructField("x", LongType())])
+        with pytest.raises(ValueError, match="not a bytes column"):
+            _native.NativeDecoder(schema, hash_buckets={"x": 8})
+
+    def test_dataset_fused_hash_to_host_batch(self, sandbox):
+        import tpu_tfrecord.io as tfio
+        from tpu_tfrecord.io.dataset import TFRecordDataset
+        from tpu_tfrecord.tpu.ingest import host_batch_from_columnar
+
+        schema = StructType([StructField("c", StringType()), StructField("x", LongType())])
+        rows = [[f"u{k % 7}", k] for k in range(32)]
+        out = str(sandbox / "fh")
+        tfio.write(rows, schema, out, mode="overwrite")
+        hb_spec = {"c": 64}
+        ds = TFRecordDataset(out, batch_size=32, schema=schema, hash_buckets=hb_spec)
+        with ds.batches() as it:
+            cb = next(it)
+        hb = host_batch_from_columnar(cb, ds.schema, hash_buckets=hb_spec)
+        # compare against the unfused pipeline
+        ds2 = TFRecordDataset(out, batch_size=32, schema=schema)
+        with ds2.batches() as it2:
+            cb2 = next(it2)
+        hb2 = host_batch_from_columnar(cb2, ds2.schema, hash_buckets=hb_spec)
+        np.testing.assert_array_equal(hb["c"], hb2["c"])
+        np.testing.assert_array_equal(hb["x"], hb2["x"])
+
+
+class TestFusedHashingRegressions:
+    def test_empty_bytes_list_fused_matches_unfused(self):
+        from tpu_tfrecord.tpu.ingest import hash_bytes_column
+
+        schema = StructType([StructField("c", StringType())])
+        recs = [
+            encode_example(Example(features={"c": Feature.bytes_list([b"x"])})),
+            encode_example(Example(features={"c": Feature(1, [])})),  # empty BytesList
+            encode_example(Example(features={"c": Feature.bytes_list([b"y"])})),
+        ]
+        fused = _native.NativeDecoder(schema, hash_buckets={"c": 97}).decode_batch(recs)
+        plain = _native.NativeDecoder(schema).decode_batch(recs)
+        want = hash_bytes_column(plain["c"], 97)
+        assert len(fused["c"].values) == 3  # no desync with mask/rows
+        np.testing.assert_array_equal(fused["c"].values, want)
+        np.testing.assert_array_equal(fused["c"].mask, plain["c"].mask)
+
+    def test_negative_buckets_rejected(self):
+        schema = StructType([StructField("c", StringType())])
+        with pytest.raises(ValueError, match="positive"):
+            _native.NativeDecoder(schema, hash_buckets={"c": -5})
+
+    def test_bucket_mismatch_raises_in_host_batch(self):
+        from tpu_tfrecord.tpu.ingest import host_batch_from_columnar
+
+        schema = StructType([StructField("c", StringType())])
+        recs = [encode_example(Example(features={"c": Feature.bytes_list([b"x"])}))]
+        fused = _native.NativeDecoder(schema, hash_buckets={"c": 64}).decode_batch(recs)
+        with pytest.raises(ValueError, match="hash_buckets=64"):
+            host_batch_from_columnar(fused, schema, hash_buckets={"c": 128})
+
+    def test_bucket_count_survives_slice_concat(self):
+        from tpu_tfrecord.columnar import concat_batches, slice_batch
+
+        schema = StructType([StructField("c", StringType())])
+        recs = [
+            encode_example(Example(features={"c": Feature.bytes_list([f"v{k}".encode()])}))
+            for k in range(6)
+        ]
+        fused = _native.NativeDecoder(schema, hash_buckets={"c": 31}).decode_batch(recs)
+        a = slice_batch(fused, 0, 3)
+        b = slice_batch(fused, 3, 6)
+        merged = concat_batches([a, b])
+        assert merged["c"].hash_buckets == 31

@@ -178,3 +178,38 @@ class TestAbandonedIterator:
         while threading.active_count() > before and _time.time() < deadline:
             _time.sleep(0.1)
         assert threading.active_count() <= before + 1  # poll-loop grace
+
+
+class TestTracing:
+    def test_trace_and_duty_cycle(self):
+        from tpu_tfrecord.tracing import DutyCycle, trace
+        import time as _t
+
+        with trace("host-region"):
+            pass
+        d = DutyCycle()
+        with d.wait():
+            _t.sleep(0.01)
+        with d.step():
+            _t.sleep(0.03)
+        # assert the arithmetic, not OS scheduler timing
+        assert d.busy_seconds > 0 and d.wait_seconds > 0
+        assert d.value() == pytest.approx(
+            d.busy_seconds / (d.busy_seconds + d.wait_seconds)
+        )
+        assert DutyCycle().value() is None
+
+
+class TestHashBucketsValidation:
+    def test_bad_hash_buckets_raise(self, sandbox):
+        from tpu_tfrecord.schema import StringType
+
+        schema = StructType([StructField("c", StringType()), StructField("x", LongType())])
+        out = str(sandbox / "hv")
+        tfio.write([["a", 1]], schema, out, mode="overwrite")
+        with pytest.raises(ValueError, match="no such data column"):
+            TFRecordDataset(out, batch_size=1, schema=schema, hash_buckets={"nope": 8})
+        with pytest.raises(ValueError, match="string/binary"):
+            TFRecordDataset(out, batch_size=1, schema=schema, hash_buckets={"x": 8})
+        with pytest.raises(ValueError, match="positive"):
+            TFRecordDataset(out, batch_size=1, schema=schema, hash_buckets={"c": 0})

@@ -82,7 +82,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.tfr_decode_batch.argtypes = [
         ctypes.c_char_p, u64p, u64p, ctypes.c_int64, ctypes.c_int32,
         ctypes.c_int32, ctypes.POINTER(ctypes.c_char_p),
-        i32p, i32p, i32p, u8p, ctypes.c_char_p, ctypes.c_int64,
+        i32p, i32p, i32p, u8p, i64p, ctypes.c_char_p, ctypes.c_int64,
     ]
     for name in ("tfr_result_values",):
         fn = getattr(lib, name)
@@ -232,7 +232,12 @@ class NativeDecoder:
     """Batch decoder backed by the C++ library. Interface mirrors
     columnar.ColumnarDecoder but consumes (buf, offsets, lengths) spans."""
 
-    def __init__(self, schema: StructType, record_type: RecordType = RecordType.EXAMPLE):
+    def __init__(
+        self,
+        schema: StructType,
+        record_type: RecordType = RecordType.EXAMPLE,
+        hash_buckets: Optional[Dict[str, int]] = None,
+    ):
         lib = load()
         if lib is None:
             raise RuntimeError(f"native library unavailable: {_load_error}")
@@ -248,6 +253,21 @@ class NativeDecoder:
         self._layouts = np.array([s[0] for s in specs], dtype=np.int32)
         self._kinds = np.array([s[1] for s in specs], dtype=np.int32)
         self._dtypes = np.array([s[2] for s in specs], dtype=np.int32)
+        # Fused categorical hashing: a hashed bytes column decodes straight
+        # to int32 bucket indices (no blob materialization at all).
+        hash_buckets = hash_buckets or {}
+        self.hash_buckets = dict(hash_buckets)
+        self._hash = np.zeros(n, dtype=np.int64)
+        for i, f in enumerate(schema):
+            if f.name not in hash_buckets:
+                continue
+            b = int(hash_buckets[f.name])
+            if b <= 0:
+                raise ValueError(f"hash_buckets[{f.name}] must be positive, got {b}")
+            if int(self._kinds[i]) != proto.BYTES_LIST:
+                raise ValueError(f"hash_buckets[{f.name}]: not a bytes column")
+            self._hash[i] = b
+            self._dtypes[i] = _DT_I32
         self._nullables = np.array([1 if f.nullable else 0 for f in schema], dtype=np.uint8)
         self._fmt = 0 if self.record_type == RecordType.EXAMPLE else 1
 
@@ -271,6 +291,7 @@ class NativeDecoder:
             self._kinds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             self._dtypes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             self._nullables.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            self._hash.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             errbuf,
             len(errbuf),
         )
@@ -299,7 +320,11 @@ class NativeDecoder:
         for i, field in enumerate(self.schema):
             layout = int(self._layouts[i])
             dt = int(self._dtypes[i])
-            col = Column(field.name, field.data_type)
+            col = Column(
+                field.name,
+                field.data_type,
+                hash_buckets=int(self._hash[i]) if self._hash[i] else None,
+            )
 
             mptr = ctypes.POINTER(ctypes.c_uint8)()
             mlen = lib.tfr_result_mask(handle, i, ctypes.byref(mptr))
@@ -445,13 +470,15 @@ def make_encoder(schema: StructType, record_type) -> Optional["NativeEncoder"]:
         return None
 
 
-def make_decoder(schema: StructType, record_type) -> Optional[NativeDecoder]:
+def make_decoder(
+    schema: StructType, record_type, hash_buckets: Optional[Dict[str, int]] = None
+) -> Optional[NativeDecoder]:
     """NativeDecoder if the schema/record type is natively supported and the
     library loads, else None (caller uses the Python ColumnarDecoder)."""
     rt = RecordType.parse(record_type) if not isinstance(record_type, RecordType) else record_type
     if rt == RecordType.BYTE_ARRAY or not available():
         return None
     try:
-        return NativeDecoder(schema, rt)
+        return NativeDecoder(schema, rt, hash_buckets)
     except ValueError:
         return None

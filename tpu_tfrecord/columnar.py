@@ -62,7 +62,7 @@ class Column:
     """
 
     __slots__ = ("name", "dtype", "values", "offsets", "inner_offsets",
-                 "blob", "blob_offsets", "mask")
+                 "blob", "blob_offsets", "mask", "hash_buckets")
 
     def __init__(
         self,
@@ -74,6 +74,7 @@ class Column:
         blob: Optional[bytes] = None,
         blob_offsets: Optional[np.ndarray] = None,
         mask: Optional[np.ndarray] = None,
+        hash_buckets: Optional[int] = None,
     ):
         self.name = name
         self.dtype = dtype
@@ -83,6 +84,9 @@ class Column:
         self.blob = blob
         self.blob_offsets = blob_offsets
         self.mask = mask  # validity per row
+        # set when a bytes column was hash-fused during decode: the bucket
+        # count its int32 values were computed with
+        self.hash_buckets = hash_buckets
 
     @property
     def is_ragged(self) -> bool:
@@ -435,7 +439,12 @@ def slice_batch(batch: ColumnarBatch, start: int, stop: int) -> ColumnarBatch:
     stop = min(batch.num_rows, stop)
     out: Dict[str, Column] = {}
     for name, col in batch.columns.items():
-        new = Column(name, col.dtype, mask=col.mask[start:stop] if col.mask is not None else None)
+        new = Column(
+            name,
+            col.dtype,
+            mask=col.mask[start:stop] if col.mask is not None else None,
+            hash_buckets=col.hash_buckets,
+        )
         if col.inner_offsets is not None:  # ragged2
             o0, o1 = int(col.offsets[start]), int(col.offsets[stop])
             inner = col.inner_offsets[o0 : o1 + 1]
@@ -470,7 +479,7 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
     out: Dict[str, Column] = {}
     for name, col0 in first.columns.items():
         cols = [b.columns[name] for b in batches]
-        new = Column(name, col0.dtype)
+        new = Column(name, col0.dtype, hash_buckets=col0.hash_buckets)
         if col0.mask is not None:
             new.mask = np.concatenate([c.mask for c in cols])
         if col0.inner_offsets is not None:

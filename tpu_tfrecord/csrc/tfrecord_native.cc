@@ -145,6 +145,7 @@ struct ColBuilder {
   int32_t kind = KIND_INT64;
   int32_t dtype = DT_I64;
   bool nullable = true;
+  int64_t hash_buckets = 0;  // >0: bytes values hash to i32 during decode
   std::string name;
 
   std::vector<int64_t> i64;
@@ -163,7 +164,7 @@ struct ColBuilder {
   void init_offsets() {
     row_offsets.push_back(0);
     if (layout == LAYOUT_RAGGED2) inner_offsets.push_back(0);
-    if (dtype == DT_BYTES) blob_offsets.push_back(0);
+    if (dtype == DT_BYTES && hash_buckets == 0) blob_offsets.push_back(0);
   }
 
   inline void push_i64(int64_t v) {
@@ -358,7 +359,16 @@ int64_t parse_feature_values(const uint8_t* fp, const uint8_t* fend,
         if (lwt != 2) { if (!skip_field(lc, lwt)) { err = "bad bytes enc"; return -1; } continue; }
         uint64_t blen;
         if (!read_varint(lc, &blen) || (uint64_t)(lc.end - lc.p) < blen) { err = "truncated bytes"; return -1; }
-        if (!scalar || count == 0) col.push_bytes(lc.p, blen);
+        if (!scalar || count == 0) {
+          if (col.hash_buckets > 0) {
+            // fused categorical hashing: bytes -> embedding-row index,
+            // no blob ever materialized
+            uint32_t h = crc32c_impl(lc.p, blen, 0);
+            col.i32.push_back((int32_t)(h % (uint64_t)col.hash_buckets));
+          } else {
+            col.push_bytes(lc.p, blen);
+          }
+        }
         lc.p += blen;
         count++;
       }
@@ -428,8 +438,13 @@ bool parse_features_map(const uint8_t* p, const uint8_t* end, const FieldMap& fi
     if (scalar) {
       if (n == 0) {
         if (col.kind == KIND_BYTES) {
-          // Empty BytesList scalar decodes as b"" (Python oracle parity).
-          col.blob_offsets.push_back((int64_t)col.blob.size());
+          if (col.hash_buckets > 0) {
+            // hash of b"" — crc32c("") == 0 (Python oracle parity)
+            col.i32.push_back((int32_t)(0 % (uint64_t)col.hash_buckets));
+          } else {
+            // Empty BytesList scalar decodes as b"" (Python oracle parity).
+            col.blob_offsets.push_back((int64_t)col.blob.size());
+          }
         } else {
           err = "column " + col.name + ": empty feature for scalar";
           return false;
@@ -593,6 +608,7 @@ void* tfr_decode_batch(const uint8_t* buf,
                        int32_t n_fields, const char** field_names,
                        const int32_t* layouts, const int32_t* kinds,
                        const int32_t* dtypes, const uint8_t* nullables,
+                       const int64_t* hash_buckets,
                        char* errbuf, int64_t errbuf_len) {
   auto* res = new BatchResult();
   res->cols.resize(n_fields);
@@ -604,6 +620,7 @@ void* tfr_decode_batch(const uint8_t* buf,
     col.kind = kinds[i];
     col.dtype = dtypes[i];
     col.nullable = nullables[i] != 0;
+    col.hash_buckets = hash_buckets ? hash_buckets[i] : 0;
     col.init_offsets();
     fields.emplace(col.name, i);
     // Pre-size the common buffers for the batch.

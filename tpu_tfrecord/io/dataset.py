@@ -82,6 +82,7 @@ class TFRecordDataset:
         shuffle: bool = False,
         seed: int = 0,
         read_retries: int = 0,
+        hash_buckets: Optional[Dict[str, int]] = None,
         **option_kwargs: Any,
     ):
         self._reader = (
@@ -108,9 +109,30 @@ class TFRecordDataset:
             sh for i, sh in enumerate(all_shards) if i % process_count == process_index
         ]
         self._decoder = ColumnarDecoder(self._data_schema, self.options.record_type)
+        # hash_buckets fuses categorical hashing into the native decode:
+        # those bytes columns come out as int32 bucket indices directly.
+        # Validate eagerly — a typo'd or non-bytes column name must fail
+        # loudly, not silently disable the fast path.
+        from tpu_tfrecord.schema import BinaryType, StringType
+
+        for name, buckets in (hash_buckets or {}).items():
+            if name not in self._data_schema:
+                raise ValueError(
+                    f"hash_buckets[{name!r}]: no such data column "
+                    f"(have {self._data_schema.names})"
+                )
+            if not isinstance(
+                self._data_schema[name].data_type, (StringType, BinaryType)
+            ):
+                raise ValueError(
+                    f"hash_buckets[{name!r}]: not a string/binary column"
+                )
+            if int(buckets) <= 0:
+                raise ValueError(f"hash_buckets[{name!r}] must be positive")
         self._native_decoder = _native.make_decoder(
-            self._data_schema, self.options.record_type
+            self._data_schema, self.options.record_type, hash_buckets
         )
+        self.hash_buckets = dict(hash_buckets or {})
         self.num_workers = max(1, num_workers)
         self.shuffle = shuffle
         self.seed = seed
@@ -188,9 +210,11 @@ class TFRecordDataset:
         chunk_records = max(self.batch_size, 2048)
         buf, offsets, lengths = self._shard_spans(self.shards[shard_idx])
         n = len(offsets)
+        from tpu_tfrecord.tracing import trace
+
         for start in range(skip, n, chunk_records):
             stop = min(start + chunk_records, n)
-            with timed("decode", METRICS) as t:
+            with timed("decode", METRICS) as t, trace("tfr:decode"):
                 chunk = self._decode_chunk(buf, offsets[start:stop], lengths[start:stop])
                 t.records += chunk.num_rows
                 t.bytes += int(lengths[start:stop].sum())

@@ -148,7 +148,20 @@ def host_batch_from_columnar(
             if f.name in hash_buckets:
                 if col.is_ragged:
                     raise ValueError(f"{f.name}: hashing ragged bytes unsupported")
-                out[f.name] = hash_bytes_column(col, hash_buckets[f.name])
+                if col.values is not None:
+                    # already hashed during decode (fused native path)
+                    if (
+                        col.hash_buckets is not None
+                        and col.hash_buckets != hash_buckets[f.name]
+                    ):
+                        raise ValueError(
+                            f"{f.name}: decoded with hash_buckets="
+                            f"{col.hash_buckets} but host batch requests "
+                            f"{hash_buckets[f.name]}"
+                        )
+                    out[f.name] = col.values
+                else:
+                    out[f.name] = hash_bytes_column(col, hash_buckets[f.name])
             continue
         if isinstance(dt, ArrayType):
             if isinstance(dt.element_type, ArrayType):
@@ -196,8 +209,10 @@ def make_global_batch(
     ``axis``. Each host contributes its local rows; across P processes the
     global batch dim is P * local_batch (jax.make_array_from_process_local_data
     — the BASELINE.json north-star assembly path)."""
+    from tpu_tfrecord.tracing import trace
+
     out: Dict[str, jax.Array] = {}
-    with timed("h2d", METRICS) as t:
+    with timed("h2d", METRICS) as t, trace("tfr:h2d"):
         for name, arr in host_batch.items():
             sharding = NamedSharding(mesh, P(axis, *([None] * (arr.ndim - 1))))
             out[name] = jax.make_array_from_process_local_data(sharding, arr)

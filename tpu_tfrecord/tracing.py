@@ -1,0 +1,94 @@
+"""Profiling hooks: jax.profiler integration + device duty-cycle estimation.
+
+The reference has no observability of its own (SURVEY.md §5: tracing ABSENT
+— it rides on Spark's UI). Here the input pipeline is the product, so it can
+explain itself:
+
+- ``trace(name)``: annotates a host-side region so it shows up on the xprof
+  timeline next to device ops (no-op when jax/profiler is unavailable).
+- ``start_trace/stop_trace``: wrap jax.profiler for a whole capture.
+- ``DutyCycle``: estimates the BASELINE.md north-star secondary metric — the
+  fraction of wall time the device spends computing vs waiting on input —
+  from step/wait timestamps recorded in the training loop.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+
+_PROF = None
+_PROF_CHECKED = False
+
+
+def _profiler():
+    global _PROF, _PROF_CHECKED
+    if not _PROF_CHECKED:
+        _PROF_CHECKED = True
+        try:
+            import jax.profiler as prof
+
+            _PROF = prof
+        except Exception:  # pragma: no cover - jax always present in this repo
+            _PROF = None
+    return _PROF
+
+
+@contextlib.contextmanager
+def trace(name: str):
+    """Annotate a host-side region on the profiler timeline."""
+    prof = _profiler()
+    if prof is None:
+        yield
+        return
+    with prof.TraceAnnotation(name):
+        yield
+
+
+def start_trace(logdir: str) -> None:
+    prof = _profiler()
+    if prof is not None:
+        prof.start_trace(logdir)
+
+
+def stop_trace() -> None:
+    prof = _profiler()
+    if prof is not None:
+        prof.stop_trace()
+
+
+class DutyCycle:
+    """Track device busy vs input-wait time in a training loop.
+
+    Usage::
+
+        duty = DutyCycle()
+        for batch in it:
+            with duty.wait():     # host blocked on input pipeline
+                gb = make_global_batch(...)
+            with duty.step():     # device computing (block_until_ready inside)
+                loss = step(gb)
+        print(duty.value())       # busy / (busy + wait)
+    """
+
+    def __init__(self):
+        self.busy_seconds = 0.0
+        self.wait_seconds = 0.0
+
+    @contextlib.contextmanager
+    def step(self):
+        t0 = time.perf_counter()
+        yield
+        self.busy_seconds += time.perf_counter() - t0
+
+    @contextlib.contextmanager
+    def wait(self):
+        t0 = time.perf_counter()
+        yield
+        self.wait_seconds += time.perf_counter() - t0
+
+    def value(self) -> Optional[float]:
+        total = self.busy_seconds + self.wait_seconds
+        return self.busy_seconds / total if total > 0 else None
