@@ -87,6 +87,10 @@ def main() -> None:
     schema = criteo_schema()
     hash_buckets = {f"C{i}": HASH_BUCKETS for i in range(1, 27)}
 
+    pack = {
+        "dense": [f"I{i}" for i in range(1, 14)],
+        "cat": [f"C{i}" for i in range(1, 27)],
+    }
     mesh = create_mesh()  # all available devices on the 'data' axis
     ds = TFRecordDataset(
         data_dir,
@@ -95,12 +99,9 @@ def main() -> None:
         num_epochs=None,
         prefetch=4,
         hash_buckets=hash_buckets,  # fused into native decode
+        pack=pack,              # groups assembled in C++ as [B, K] matrices
     )
 
-    pack = {
-        "dense": [f"I{i}" for i in range(1, 14)],
-        "cat": [f"C{i}" for i in range(1, 27)],
-    }
     examples = 0
     measuring = False
     t_start = t_end = 0.0

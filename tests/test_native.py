@@ -303,7 +303,7 @@ class TestFusedHashing:
 
     def test_hashing_non_bytes_column_rejected(self):
         schema = StructType([StructField("x", LongType())])
-        with pytest.raises(ValueError, match="not a bytes column"):
+        with pytest.raises(ValueError, match="not a string/binary column"):
             _native.NativeDecoder(schema, hash_buckets={"x": 8})
 
     def test_dataset_fused_hash_to_host_batch(self, sandbox):
@@ -373,3 +373,142 @@ class TestFusedHashingRegressions:
         b = slice_batch(fused, 3, 6)
         merged = concat_batches([a, b])
         assert merged["c"].hash_buckets == 31
+
+
+class TestGroupPacking:
+    """pack: scalar column groups decode into [B, K] matrices in C++."""
+
+    SCHEMA = StructType(
+        [StructField("label", LongType())]
+        + [StructField(f"I{i}", LongType()) for i in range(4)]
+        + [StructField(f"C{i}", StringType()) for i in range(3)]
+    )
+
+    def make_recs(self, n=30):
+        rng = np.random.default_rng(3)
+        recs = []
+        for k in range(n):
+            feats = {"label": Feature.int64_list([k % 2])}
+            for i in range(4):
+                if (k + i) % 9 != 5:  # some missing
+                    feats[f"I{i}"] = Feature.int64_list([int(rng.integers(0, 1 << 40))])
+            for i in range(3):
+                feats[f"C{i}"] = Feature.bytes_list([f"c{k % 7}".encode()])
+            recs.append(encode_example(Example(features=feats)))
+        return recs
+
+    def test_group_matrix_matches_stacked_columns(self):
+        recs = self.make_recs()
+        hb = {f"C{i}": 53 for i in range(3)}
+        pack = {"dense": [f"I{i}" for i in range(4)], "cat": [f"C{i}" for i in range(3)]}
+        packed = _native.NativeDecoder(self.SCHEMA, hash_buckets=hb, pack=pack).decode_batch(recs)
+        plain = _native.NativeDecoder(self.SCHEMA, hash_buckets=hb).decode_batch(recs)
+        dense_want = np.stack([plain[f"I{i}"].values for i in range(4)], axis=1)
+        cat_want = np.stack([plain[f"C{i}"].values for i in range(3)], axis=1)
+        np.testing.assert_array_equal(packed["dense"].values, dense_want)
+        assert packed["dense"].values.dtype == np.int64
+        np.testing.assert_array_equal(packed["cat"].values, cat_want)
+        assert packed["cat"].values.dtype == np.int32
+        # ungrouped column still a normal scalar column
+        np.testing.assert_array_equal(packed["label"].values, plain["label"].values)
+        # member columns are not emitted separately
+        assert "I0" not in packed.columns
+
+    def test_missing_grouped_field_is_zero(self):
+        schema = StructType([StructField("a", LongType()), StructField("b", LongType())])
+        recs = [encode_example(Example(features={"a": Feature.int64_list([7])}))]
+        packed = _native.NativeDecoder(schema, pack={"g": ["a", "b"]}).decode_batch(recs)
+        np.testing.assert_array_equal(packed["g"].values, [[7, 0]])
+
+    def test_mixed_dtype_group_rejected(self):
+        schema = StructType([StructField("a", LongType()), StructField("b", FloatType())])
+        with pytest.raises(ValueError, match="one dtype"):
+            _native.NativeDecoder(schema, pack={"g": ["a", "b"]})
+
+    def test_dataset_pack_end_to_end(self, sandbox):
+        import tpu_tfrecord.io as tfio
+        from tpu_tfrecord.io.dataset import TFRecordDataset
+        from tpu_tfrecord.tpu.ingest import host_batch_from_columnar
+
+        schema = StructType(
+            [StructField("x", LongType()), StructField("y", LongType()),
+             StructField("c", StringType())]
+        )
+        rows = [[k, k * 2, f"u{k % 5}"] for k in range(40)]
+        out = str(sandbox / "gp")
+        tfio.write(rows, schema, out, mode="overwrite")
+        hb = {"c": 16}
+        pack = {"dense": ["x", "y"]}
+        ds = TFRecordDataset(out, batch_size=20, schema=schema,
+                             hash_buckets=hb, pack=pack)
+        host_batches = []
+        with ds.batches() as it:
+            for cb in it:
+                assert "dense" in cb.columns
+                host_batches.append(
+                    host_batch_from_columnar(cb, ds.schema, hash_buckets=hb, pack=pack)
+                )
+        # unpacked pipeline must agree
+        ds2 = TFRecordDataset(out, batch_size=20, schema=schema, hash_buckets=hb)
+        ref = []
+        with ds2.batches() as it2:
+            for cb in it2:
+                ref.append(host_batch_from_columnar(cb, ds2.schema, hash_buckets=hb, pack=pack))
+        for a, b in zip(host_batches, ref):
+            assert set(a) == set(b)
+            for k in a:
+                np.testing.assert_array_equal(a[k], b[k])
+
+    def test_dataset_pack_validation(self, sandbox):
+        import tpu_tfrecord.io as tfio
+        from tpu_tfrecord.io.dataset import TFRecordDataset
+
+        schema = StructType([StructField("x", LongType()), StructField("c", StringType())])
+        out = str(sandbox / "gpv")
+        tfio.write([[1, "a"]], schema, out, mode="overwrite")
+        with pytest.raises(ValueError, match="no such data column"):
+            TFRecordDataset(out, batch_size=1, schema=schema, pack={"g": ["zz"]})
+        with pytest.raises(ValueError, match="hash_buckets"):
+            TFRecordDataset(out, batch_size=1, schema=schema, pack={"g": ["c"]})
+        with pytest.raises(ValueError, match="collides"):
+            TFRecordDataset(out, batch_size=1, schema=schema, pack={"x": ["x"]})
+
+    def test_dataset_mixed_dtype_pack_rejected(self, sandbox):
+        import tpu_tfrecord.io as tfio
+        from tpu_tfrecord.io.dataset import TFRecordDataset
+
+        schema = StructType([StructField("a", LongType()), StructField("f", FloatType())])
+        out = str(sandbox / "mx")
+        tfio.write([[1, 1.5]], schema, out, mode="overwrite")
+        with pytest.raises(ValueError, match="share one dtype"):
+            TFRecordDataset(out, batch_size=1, schema=schema, pack={"g": ["a", "f"]})
+
+    def test_duplicate_pack_membership_rejected(self):
+        schema = StructType([StructField("a", LongType()), StructField("b", LongType())])
+        with pytest.raises(ValueError, match="packed once"):
+            _native.NativeDecoder(schema, pack={"g1": ["a"], "g2": ["a", "b"]})
+        with pytest.raises(ValueError, match="packed once"):
+            _native.NativeDecoder(schema, pack={"g": ["a", "a"]})
+
+    def test_empty_pack_group_rejected(self):
+        schema = StructType([StructField("a", LongType())])
+        with pytest.raises(ValueError, match="no members"):
+            _native.NativeDecoder(schema, pack={"g": []})
+
+    def test_duplicate_key_missing_last_occurrence_grouped(self):
+        """Duplicate map key where the LAST occurrence has an unset oneof:
+        missing->0 must hold in the group matrix (stale value zeroed)."""
+        def entry(payload_feature):
+            e = bytes([0x0A, 1, ord("a"), 0x12, len(payload_feature)]) + payload_feature
+            return bytes([0x0A, len(e)]) + e
+
+        int64_list = bytes([0x0A, 0x01, 7])
+        full = bytes([0x1A, len(int64_list)]) + int64_list  # int64_list [7]
+        empty_feature = b""  # unset oneof
+        features = entry(full) + entry(empty_feature)
+        record = bytes([0x0A, len(features)]) + features
+        schema = StructType([StructField("a", LongType()), StructField("b", LongType())])
+        packed = _native.NativeDecoder(schema, pack={"g": ["a", "b"]}).decode_batch([record])
+        np.testing.assert_array_equal(packed["g"].values, [[0, 0]])
+        plain = _native.NativeDecoder(schema).decode_batch([record])
+        assert plain["a"].values[0] == 0 and not plain["a"].mask[0]

@@ -82,8 +82,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.tfr_decode_batch.argtypes = [
         ctypes.c_char_p, u64p, u64p, ctypes.c_int64, ctypes.c_int32,
         ctypes.c_int32, ctypes.POINTER(ctypes.c_char_p),
-        i32p, i32p, i32p, u8p, i64p, ctypes.c_char_p, ctypes.c_int64,
+        i32p, i32p, i32p, u8p, i64p,
+        i32p, i64p, ctypes.c_int32, i64p,
+        ctypes.c_char_p, ctypes.c_int64,
     ]
+    lib.tfr_result_group.restype = ctypes.c_int64
+    lib.tfr_result_group.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(u8p)]
     for name in ("tfr_result_values",):
         fn = getattr(lib, name)
         fn.restype = ctypes.c_int64
@@ -201,9 +205,15 @@ _DT_I64, _DT_I32, _DT_F32, _DT_F64, _DT_BYTES = 0, 1, 2, 3, -1
 _DT_NP = {_DT_I64: np.int64, _DT_I32: np.int32, _DT_F32: np.float32, _DT_F64: np.float64}
 
 
+class UnsupportedSchemaError(ValueError):
+    """Schema not representable natively — callers fall back to Python.
+    Distinct from configuration errors (bad pack/hash_buckets), which always
+    raise to the user instead of silently disabling the fast path."""
+
+
 def _field_spec(name: str, dtype: DataType) -> Tuple[int, int, int]:
-    """(layout, kind, out_dtype) for a schema field; raises if unsupported
-    natively (caller falls back to Python)."""
+    """(layout, kind, out_dtype) for a schema field; raises
+    UnsupportedSchemaError if unsupported natively."""
     elem: DataType = dtype
     layout = _LAYOUT_SCALAR
     if isinstance(dtype, ArrayType):
@@ -211,7 +221,7 @@ def _field_spec(name: str, dtype: DataType) -> Tuple[int, int, int]:
             layout = _LAYOUT_RAGGED2
             elem = dtype.element_type.element_type
             if isinstance(elem, ArrayType):
-                raise ValueError(">2-level nesting")
+                raise UnsupportedSchemaError(">2-level nesting")
         else:
             layout = _LAYOUT_RAGGED
             elem = dtype.element_type
@@ -225,7 +235,71 @@ def _field_spec(name: str, dtype: DataType) -> Tuple[int, int, int]:
         return layout, proto.FLOAT_LIST, _DT_F64
     if isinstance(elem, (StringType, BinaryType)):
         return layout, proto.BYTES_LIST, _DT_BYTES
-    raise ValueError(f"unsupported native type {elem}")
+    raise UnsupportedSchemaError(f"unsupported native type {elem}")
+
+
+def validate_hash_buckets(schema: StructType, hash_buckets) -> Dict[str, int]:
+    """Shared eager validation for hash_buckets (used by NativeDecoder AND
+    TFRecordDataset so a config typo can never silently disable the fast
+    path)."""
+    out: Dict[str, int] = {}
+    for name, buckets in (hash_buckets or {}).items():
+        if name not in schema:
+            raise ValueError(
+                f"hash_buckets[{name!r}]: no such data column (have {schema.names})"
+            )
+        if not isinstance(schema[name].data_type, (StringType, BinaryType)):
+            raise ValueError(f"hash_buckets[{name!r}]: not a string/binary column")
+        b = int(buckets)
+        if b <= 0:
+            raise ValueError(f"hash_buckets[{name!r}] must be positive, got {b}")
+        out[name] = b
+    return out
+
+
+def validate_pack(schema: StructType, pack, hash_buckets) -> Dict[str, List[str]]:
+    """Shared eager validation for column-group packing: group names must not
+    collide with columns; members must exist, be scalar, be numeric (or
+    hashed bytes), be listed exactly once anywhere, share one output dtype;
+    groups must be non-empty."""
+    hash_buckets = hash_buckets or {}
+    seen_members: Dict[str, str] = {}
+    out: Dict[str, List[str]] = {}
+    for gname, members in (pack or {}).items():
+        if gname in schema:
+            raise ValueError(f"pack group {gname!r} collides with a column name")
+        if not members:
+            raise ValueError(f"pack[{gname}]: group has no members")
+        dtypes = set()
+        for m in members:
+            if m in seen_members:
+                raise ValueError(
+                    f"pack[{gname}]: column {m!r} already in group "
+                    f"{seen_members[m]!r} — a column may be packed once"
+                )
+            seen_members[m] = gname
+            if m not in schema:
+                raise ValueError(
+                    f"pack[{gname}]: no such data column {m!r} (have {schema.names})"
+                )
+            mdt = schema[m].data_type
+            if isinstance(mdt, ArrayType):
+                raise ValueError(f"pack[{gname}]: {m} is not a scalar column")
+            if isinstance(mdt, (StringType, BinaryType)):
+                if m not in hash_buckets:
+                    raise ValueError(
+                        f"pack[{gname}]: {m} is a bytes column (add it to "
+                        "hash_buckets to pack it)"
+                    )
+                dtypes.add(_DT_I32)
+            else:
+                dtypes.add(_field_spec(m, mdt)[2])
+        if len(dtypes) != 1:
+            raise ValueError(
+                f"pack[{gname}]: members must share one dtype"
+            )
+        out[gname] = list(members)
+    return out
 
 
 class NativeDecoder:
@@ -237,6 +311,7 @@ class NativeDecoder:
         schema: StructType,
         record_type: RecordType = RecordType.EXAMPLE,
         hash_buckets: Optional[Dict[str, int]] = None,
+        pack: Optional[Dict[str, List[str]]] = None,
     ):
         lib = load()
         if lib is None:
@@ -255,21 +330,29 @@ class NativeDecoder:
         self._dtypes = np.array([s[2] for s in specs], dtype=np.int32)
         # Fused categorical hashing: a hashed bytes column decodes straight
         # to int32 bucket indices (no blob materialization at all).
-        hash_buckets = hash_buckets or {}
-        self.hash_buckets = dict(hash_buckets)
+        self.hash_buckets = validate_hash_buckets(schema, hash_buckets)
         self._hash = np.zeros(n, dtype=np.int64)
         for i, f in enumerate(schema):
-            if f.name not in hash_buckets:
-                continue
-            b = int(hash_buckets[f.name])
-            if b <= 0:
-                raise ValueError(f"hash_buckets[{f.name}] must be positive, got {b}")
-            if int(self._kinds[i]) != proto.BYTES_LIST:
-                raise ValueError(f"hash_buckets[{f.name}]: not a bytes column")
-            self._hash[i] = b
-            self._dtypes[i] = _DT_I32
+            if f.name in self.hash_buckets:
+                self._hash[i] = self.hash_buckets[f.name]
+                self._dtypes[i] = _DT_I32
         self._nullables = np.array([1 if f.nullable else 0 for f in schema], dtype=np.uint8)
         self._fmt = 0 if self.record_type == RecordType.EXAMPLE else 1
+        # Column-group packing: same-dtype scalar fields decode straight into
+        # one [n_records, width] matrix per group.
+        self.pack = validate_pack(schema, pack, self.hash_buckets)
+        self._group_ids = np.full(n, -1, dtype=np.int32)
+        self._group_offs = np.zeros(n, dtype=np.int64)
+        self._group_strides = np.zeros(len(self.pack), dtype=np.int64)
+        self._group_meta: List[Tuple[str, np.dtype, int]] = []  # (name, dtype, width)
+        for g, (gname, members) in enumerate(self.pack.items()):
+            np_dt = np.dtype(_DT_NP[int(self._dtypes[schema.field_index(members[0])])])
+            self._group_strides[g] = np_dt.itemsize * len(members)
+            for pos, m in enumerate(members):
+                i = schema.field_index(m)
+                self._group_ids[i] = g
+                self._group_offs[i] = np_dt.itemsize * pos
+            self._group_meta.append((gname, np_dt, len(members)))
 
     def decode_spans(
         self, buf: bytes, offsets: np.ndarray, lengths: np.ndarray
@@ -292,6 +375,10 @@ class NativeDecoder:
             self._dtypes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             self._nullables.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             self._hash.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            self._group_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            self._group_offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(self._group_meta),
+            self._group_strides.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             errbuf,
             len(errbuf),
         )
@@ -317,7 +404,17 @@ class NativeDecoder:
     def _extract(self, handle, n_records: int) -> ColumnarBatch:
         lib = self._lib
         cols: Dict[str, Column] = {}
+        for g, (gname, np_dt, width) in enumerate(self._group_meta):
+            gptr = ctypes.POINTER(ctypes.c_uint8)()
+            gbytes = lib.tfr_result_group(handle, g, ctypes.byref(gptr))
+            values = _np_copy(gptr, gbytes, np_dt).reshape(n_records, width)
+            # Group columns use the first member's schema dtype; per-field
+            # validity is intentionally dropped (missing -> 0).
+            first = self.pack[gname][0]
+            cols[gname] = Column(gname, self.schema[first].data_type, values=values)
         for i, field in enumerate(self.schema):
+            if int(self._group_ids[i]) >= 0:
+                continue  # lives in a group matrix
             layout = int(self._layouts[i])
             dt = int(self._dtypes[i])
             col = Column(
@@ -471,7 +568,10 @@ def make_encoder(schema: StructType, record_type) -> Optional["NativeEncoder"]:
 
 
 def make_decoder(
-    schema: StructType, record_type, hash_buckets: Optional[Dict[str, int]] = None
+    schema: StructType,
+    record_type,
+    hash_buckets: Optional[Dict[str, int]] = None,
+    pack: Optional[Dict[str, List[str]]] = None,
 ) -> Optional[NativeDecoder]:
     """NativeDecoder if the schema/record type is natively supported and the
     library loads, else None (caller uses the Python ColumnarDecoder)."""
@@ -479,6 +579,9 @@ def make_decoder(
     if rt == RecordType.BYTE_ARRAY or not available():
         return None
     try:
-        return NativeDecoder(schema, rt, hash_buckets)
-    except ValueError:
+        return NativeDecoder(schema, rt, hash_buckets, pack)
+    except UnsupportedSchemaError:
+        # schema shape the C++ decoder can't represent -> Python fallback;
+        # configuration errors (bad pack/hash_buckets) propagate instead of
+        # silently disabling the fast path
         return None

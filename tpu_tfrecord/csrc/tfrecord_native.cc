@@ -146,6 +146,14 @@ struct ColBuilder {
   int32_t dtype = DT_I64;
   bool nullable = true;
   int64_t hash_buckets = 0;  // >0: bytes values hash to i32 during decode
+  // Column-group packing: scalar fields assigned to a group write straight
+  // into a shared [n_records, width] matrix at (cur_row, group_pos) instead
+  // of their own vector — the batch layout MXU consumers want, with no
+  // per-column extraction or Python-side stacking.
+  uint8_t* group_buf = nullptr;
+  int64_t group_stride = 0;  // bytes per row
+  int64_t group_off = 0;     // byte offset of this field within a row
+  int64_t cur_row = 0;
   std::string name;
 
   std::vector<int64_t> i64;
@@ -168,12 +176,31 @@ struct ColBuilder {
   }
 
   inline void push_i64(int64_t v) {
+    if (group_buf) {
+      uint8_t* p = group_buf + cur_row * group_stride + group_off;
+      if (dtype == DT_I64) std::memcpy(p, &v, 8);
+      else { int32_t t = (int32_t)v; std::memcpy(p, &t, 4); }
+      return;
+    }
     if (dtype == DT_I64) i64.push_back(v);
     else i32.push_back((int32_t)v);  // Scala Long.toInt truncation semantics
   }
   inline void push_f32(float v) {
+    if (group_buf) {
+      uint8_t* p = group_buf + cur_row * group_stride + group_off;
+      if (dtype == DT_F32) std::memcpy(p, &v, 4);
+      else { double t = (double)v; std::memcpy(p, &t, 8); }
+      return;
+    }
     if (dtype == DT_F32) f32.push_back(v);
     else f64.push_back((double)v);
+  }
+  inline void push_hashed(int32_t v) {
+    if (group_buf) {
+      std::memcpy(group_buf + cur_row * group_stride + group_off, &v, 4);
+      return;
+    }
+    i32.push_back(v);
   }
   inline void push_bytes(const uint8_t* p, uint64_t n) {
     blob.insert(blob.end(), p, p + n);
@@ -187,6 +214,14 @@ struct ColBuilder {
   void rollback() {
     if (mask.empty()) return;
     mask.pop_back();
+    if (group_buf) {
+      // Zero the slot: if the duplicate's last occurrence turns out to be
+      // missing (unset oneof), the documented missing->0 must hold — the
+      // first occurrence's value may not survive.
+      int itemsize = (dtype == DT_I64 || dtype == DT_F64) ? 8 : 4;
+      std::memset(group_buf + cur_row * group_stride + group_off, 0, itemsize);
+      return;
+    }
     if (layout == LAYOUT_SCALAR) {
       if (dtype == DT_BYTES) {
         blob_offsets.pop_back();
@@ -237,6 +272,7 @@ struct ColBuilder {
 
 struct BatchResult {
   std::vector<ColBuilder> cols;
+  std::vector<std::vector<uint8_t>> group_bufs;
   std::string error;
 };
 
@@ -364,7 +400,7 @@ int64_t parse_feature_values(const uint8_t* fp, const uint8_t* fend,
             // fused categorical hashing: bytes -> embedding-row index,
             // no blob ever materialized
             uint32_t h = crc32c_impl(lc.p, blen, 0);
-            col.i32.push_back((int32_t)(h % (uint64_t)col.hash_buckets));
+            col.push_hashed((int32_t)(h % (uint64_t)col.hash_buckets));
           } else {
             col.push_bytes(lc.p, blen);
           }
@@ -429,6 +465,7 @@ bool parse_features_map(const uint8_t* p, const uint8_t* end, const FieldMap& fi
       col.rollback();
       seen_epoch[idx] = -1;  // unseen again until the re-append succeeds
     }
+    col.cur_row = epoch;  // record index, for group-matrix writes
     bool scalar = col.layout == LAYOUT_SCALAR;
     int64_t n = fstart ? parse_feature_values(fstart, fend, col, scalar, err)
                        : -2;
@@ -440,7 +477,7 @@ bool parse_features_map(const uint8_t* p, const uint8_t* end, const FieldMap& fi
         if (col.kind == KIND_BYTES) {
           if (col.hash_buckets > 0) {
             // hash of b"" — crc32c("") == 0 (Python oracle parity)
-            col.i32.push_back((int32_t)(0 % (uint64_t)col.hash_buckets));
+            col.push_hashed(0);
           } else {
             // Empty BytesList scalar decodes as b"" (Python oracle parity).
             col.blob_offsets.push_back((int64_t)col.blob.size());
@@ -544,6 +581,7 @@ bool parse_feature_lists(const uint8_t* p, const uint8_t* end, const FieldMap& f
 
 void append_missing(ColBuilder& col) {
   col.mask.push_back(0);
+  if (col.group_buf) return;  // group matrix is zero-initialized
   if (col.layout == LAYOUT_SCALAR) {
     switch (col.dtype) {
       case DT_I64: col.i64.push_back(0); break;
@@ -609,9 +647,15 @@ void* tfr_decode_batch(const uint8_t* buf,
                        const int32_t* layouts, const int32_t* kinds,
                        const int32_t* dtypes, const uint8_t* nullables,
                        const int64_t* hash_buckets,
+                       const int32_t* group_ids, const int64_t* group_offs,
+                       int32_t n_groups, const int64_t* group_strides,
                        char* errbuf, int64_t errbuf_len) {
   auto* res = new BatchResult();
   res->cols.resize(n_fields);
+  res->group_bufs.resize(n_groups);
+  for (int32_t g = 0; g < n_groups; g++) {
+    res->group_bufs[g].assign((size_t)n_records * group_strides[g], 0);
+  }
   FieldMap fields;
   for (int32_t i = 0; i < n_fields; i++) {
     ColBuilder& col = res->cols[i];
@@ -621,11 +665,18 @@ void* tfr_decode_batch(const uint8_t* buf,
     col.dtype = dtypes[i];
     col.nullable = nullables[i] != 0;
     col.hash_buckets = hash_buckets ? hash_buckets[i] : 0;
+    if (group_ids && group_ids[i] >= 0) {
+      int32_t g = group_ids[i];
+      col.group_buf = res->group_bufs[g].data();
+      col.group_stride = group_strides[g];
+      col.group_off = group_offs[i];
+    }
     col.init_offsets();
     fields.emplace(col.name, i);
     // Pre-size the common buffers for the batch.
     col.mask.reserve(n_records);
     if (col.layout != LAYOUT_SCALAR) col.row_offsets.reserve(n_records + 1);
+    if (col.group_buf) continue;  // values live in the group matrix
     if (col.dtype == DT_BYTES) {
       col.blob_offsets.reserve(n_records + 1);
       col.blob.reserve((size_t)n_records * 8);
@@ -730,6 +781,12 @@ int64_t tfr_result_mask(void* h, int32_t i, const uint8_t** ptr) {
   ColBuilder* c = get_col(h, i);
   *ptr = c->mask.data();
   return (int64_t)c->mask.size();
+}
+
+int64_t tfr_result_group(void* h, int32_t g, const uint8_t** ptr) {
+  auto& buf = static_cast<BatchResult*>(h)->group_bufs[g];
+  *ptr = buf.data();
+  return (int64_t)buf.size();
 }
 
 void tfr_result_free(void* h) { delete static_cast<BatchResult*>(h); }

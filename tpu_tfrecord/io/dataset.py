@@ -83,6 +83,7 @@ class TFRecordDataset:
         seed: int = 0,
         read_retries: int = 0,
         hash_buckets: Optional[Dict[str, int]] = None,
+        pack: Optional[Dict[str, List[str]]] = None,
         **option_kwargs: Any,
     ):
         self._reader = (
@@ -109,30 +110,20 @@ class TFRecordDataset:
             sh for i, sh in enumerate(all_shards) if i % process_count == process_index
         ]
         self._decoder = ColumnarDecoder(self._data_schema, self.options.record_type)
-        # hash_buckets fuses categorical hashing into the native decode:
-        # those bytes columns come out as int32 bucket indices directly.
-        # Validate eagerly — a typo'd or non-bytes column name must fail
-        # loudly, not silently disable the fast path.
-        from tpu_tfrecord.schema import BinaryType, StringType
-
-        for name, buckets in (hash_buckets or {}).items():
-            if name not in self._data_schema:
-                raise ValueError(
-                    f"hash_buckets[{name!r}]: no such data column "
-                    f"(have {self._data_schema.names})"
-                )
-            if not isinstance(
-                self._data_schema[name].data_type, (StringType, BinaryType)
-            ):
-                raise ValueError(
-                    f"hash_buckets[{name!r}]: not a string/binary column"
-                )
-            if int(buckets) <= 0:
-                raise ValueError(f"hash_buckets[{name!r}] must be positive")
-        self._native_decoder = _native.make_decoder(
-            self._data_schema, self.options.record_type, hash_buckets
+        # hash_buckets fuses categorical hashing into the native decode;
+        # pack pushes column-group assembly down too ([B, K] matrices).
+        # Validation is shared with NativeDecoder and runs eagerly here even
+        # when the native library is unavailable — a config typo must fail
+        # loudly, never silently disable the fast path.
+        self.hash_buckets = _native.validate_hash_buckets(
+            self._data_schema, hash_buckets
         )
-        self.hash_buckets = dict(hash_buckets or {})
+        self.pack = _native.validate_pack(
+            self._data_schema, pack, self.hash_buckets
+        )
+        self._native_decoder = _native.make_decoder(
+            self._data_schema, self.options.record_type, self.hash_buckets, self.pack
+        )
         self.num_workers = max(1, num_workers)
         self.shuffle = shuffle
         self.seed = seed

@@ -141,7 +141,17 @@ def host_batch_from_columnar(
     pad_to = pad_to or {}
     hash_buckets = hash_buckets or {}
     out: Dict[str, np.ndarray] = {}
+    # Groups already materialized by the native decoder (pack pushed down):
+    # take their matrices directly and skip the member fields.
+    packed_members = set()
+    if pack:
+        for group, names in pack.items():
+            if group in batch:
+                out[group] = batch[group].values
+                packed_members.update(names)
     for f in schema:
+        if f.name in packed_members:
+            continue
         col = batch[f.name]
         dt = f.data_type
         if _is_bytes_like(dt):
@@ -190,6 +200,8 @@ def host_batch_from_columnar(
             out[f.name] = col.values
     if pack:
         for group, names in pack.items():
+            if group in out:
+                continue  # decoded as a matrix already
             cols = [out.pop(n) for n in names]
             out[group] = np.stack(cols, axis=1)
     return out
@@ -212,10 +224,16 @@ def make_global_batch(
     from tpu_tfrecord.tracing import trace
 
     out: Dict[str, jax.Array] = {}
+    single_process = jax.process_count() == 1
     with timed("h2d", METRICS) as t, trace("tfr:h2d"):
         for name, arr in host_batch.items():
             sharding = NamedSharding(mesh, P(axis, *([None] * (arr.ndim - 1))))
-            out[name] = jax.make_array_from_process_local_data(sharding, arr)
+            if single_process:
+                # local == global: plain sharded device_put is the same
+                # semantics with less per-call overhead
+                out[name] = jax.device_put(arr, sharding)
+            else:
+                out[name] = jax.make_array_from_process_local_data(sharding, arr)
             t.bytes += arr.nbytes
         t.records += next(iter(host_batch.values())).shape[0] if host_batch else 0
     return out
