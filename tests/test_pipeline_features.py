@@ -213,3 +213,106 @@ class TestHashBucketsValidation:
             TFRecordDataset(out, batch_size=1, schema=schema, hash_buckets={"x": 8})
         with pytest.raises(ValueError, match="positive"):
             TFRecordDataset(out, batch_size=1, schema=schema, hash_buckets={"c": 0})
+
+
+class TestSlabStreaming:
+    def test_tiny_slabs_identical_to_whole_shard(self, sandbox):
+        """Force many slabs per shard (slab smaller than one record frame
+        included): stream must be identical to the default path."""
+        out = write_shards(sandbox, num_shards=3, rows_per_shard=25)
+        ref = collect_uids(TFRecordDataset(out, batch_size=10, schema=SCHEMA))
+        for slab in (17, 64, 300):
+            got = collect_uids(
+                TFRecordDataset(out, batch_size=10, schema=SCHEMA, slab_bytes=slab)
+            )
+            assert got == ref, f"slab_bytes={slab}"
+
+    def test_tiny_slabs_resume(self, sandbox):
+        out = write_shards(sandbox, num_shards=2, rows_per_shard=30)
+        ds = TFRecordDataset(out, batch_size=8, schema=SCHEMA, slab_bytes=50)
+        with ds.batches() as it:
+            first = next(it)["uid"].values.tolist()
+            st = it.state()
+        rest = collect_uids(
+            TFRecordDataset(out, batch_size=8, schema=SCHEMA, slab_bytes=50), st
+        )
+        ref = collect_uids(TFRecordDataset(out, batch_size=8, schema=SCHEMA))
+        assert first + rest == ref
+
+    def test_truncated_tail_detected(self, sandbox):
+        from tpu_tfrecord.wire import TFRecordCorruptionError
+
+        out = write_shards(sandbox, num_shards=1, rows_per_shard=5)
+        f = [os.path.join(out, x) for x in os.listdir(out) if x.endswith(".tfrecord")][0]
+        raw = open(f, "rb").read()
+        open(f, "wb").write(raw[:-3])
+        ds = TFRecordDataset(out, batch_size=1, schema=SCHEMA, slab_bytes=64,
+                             drop_remainder=False)
+        with pytest.raises(TFRecordCorruptionError):
+            collect_uids(ds)
+
+    def test_bogus_length_bounded_not_buffered(self, sandbox):
+        """A corrupt length field with verify_crc=False must raise promptly
+        via max_record_bytes, not buffer the rest of the shard."""
+        import struct
+
+        from tpu_tfrecord.wire import TFRecordCorruptionError
+
+        out = write_shards(sandbox, num_shards=1, rows_per_shard=50)
+        f = [os.path.join(out, x) for x in os.listdir(out) if x.endswith(".tfrecord")][0]
+        raw = bytearray(open(f, "rb").read())
+        # overwrite the FIRST record's length with a huge value
+        struct.pack_into("<Q", raw, 0, 1 << 60)
+        open(f, "wb").write(bytes(raw))
+        ds = TFRecordDataset(out, batch_size=10, schema=SCHEMA, slab_bytes=64,
+                             verify_crc=False, max_record_bytes=1 << 20)
+        with pytest.raises(TFRecordCorruptionError, match="max_record_bytes"):
+            collect_uids(ds)
+
+    def test_gzip_slab_streaming(self, sandbox):
+        out = str(sandbox / "gz")
+        rows = [[i, float(i)] for i in range(40)]
+        tfio.write(rows, SCHEMA, out, mode="overwrite", codec="gzip")
+        got = collect_uids(
+            TFRecordDataset(out, batch_size=10, schema=SCHEMA, slab_bytes=100)
+        )
+        ref = collect_uids(TFRecordDataset(out, batch_size=10, schema=SCHEMA))
+        assert got == ref
+
+    def test_mid_shard_retry_no_duplicates(self, sandbox, monkeypatch):
+        """IO error mid-shard: retry must resume after the already-emitted
+        records, not duplicate them."""
+        out = write_shards(sandbox, num_shards=1, rows_per_shard=60)
+        real_open = __import__("tpu_tfrecord.wire", fromlist=["wire"]).open_compressed
+        state = {"opens": 0}
+
+        class FlakyFile:
+            def __init__(self, fh):
+                self._fh = fh
+                self._reads = 0
+
+            def read(self, n=-1):
+                self._reads += 1
+                if state["opens"] == 1 and self._reads == 3:
+                    raise OSError("mid-shard blip")
+                return self._fh.read(n)
+
+            def close(self):
+                self._fh.close()
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                self.close()
+
+        def flaky(path, mode, codec):
+            state["opens"] += 1
+            return FlakyFile(real_open(path, mode, codec))
+
+        monkeypatch.setattr("tpu_tfrecord.wire.open_compressed", flaky)
+        ds = TFRecordDataset(out, batch_size=10, schema=SCHEMA, slab_bytes=200,
+                             read_retries=2, drop_remainder=False)
+        uids = collect_uids(ds)
+        assert uids == list(range(60))
+        assert state["opens"] >= 2  # retried

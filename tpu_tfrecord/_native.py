@@ -78,6 +78,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.tfr_scan.restype = ctypes.c_int64
     lib.tfr_scan.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int32, u64p, u64p, ctypes.c_int64]
 
+    lib.tfr_scan_partial.restype = ctypes.c_int64
+    lib.tfr_scan_partial.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int32, u64p, u64p,
+        ctypes.c_int64, u64p,
+    ]
+
     lib.tfr_decode_batch.restype = ctypes.c_void_p
     lib.tfr_decode_batch.argtypes = [
         ctypes.c_char_p, u64p, u64p, ctypes.c_int64, ctypes.c_int32,
@@ -197,6 +203,33 @@ def scan(buf: bytes, verify_crc: bool = True) -> Tuple[np.ndarray, np.ndarray]:
     # Copy out of the worst-case-capacity backing arrays (sized len(buf)/16
     # entries) so holding the result doesn't pin ~buf-sized allocations.
     return offsets[:n].copy(), lengths[:n].copy()
+
+
+def scan_partial(
+    buf: bytes, verify_crc: bool = True
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Scan complete frames; a record extending past the end of the buffer is
+    a tail, not an error. Returns (offsets, lengths, consumed_bytes)."""
+    from tpu_tfrecord.wire import TFRecordCorruptionError
+
+    lib = load()
+    assert lib is not None
+    cap = max(1, len(buf) // 16)
+    offsets = np.empty(cap, dtype=np.uint64)
+    lengths = np.empty(cap, dtype=np.uint64)
+    consumed = ctypes.c_uint64(0)
+    n = lib.tfr_scan_partial(
+        buf,
+        len(buf),
+        1 if verify_crc else 0,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        cap,
+        ctypes.byref(consumed),
+    )
+    if n < 0:
+        raise TFRecordCorruptionError(_SCAN_ERRORS.get(int(n), f"scan error {n}"))
+    return offsets[:n].copy(), lengths[:n].copy(), int(consumed.value)
 
 
 # layout/kind/dtype codes must match tfrecord_native.cc

@@ -604,25 +604,44 @@ uint32_t tfr_crc32c(const uint8_t* data, uint64_t len) {
   return crc32c_impl(data, len, 0);
 }
 
-// Scan TFRecord framing. Fills offsets/lengths (payload spans). Returns
-// record count, or -1 (corrupt length crc), -2 (truncated), -3 (bad data
-// crc), -4 (capacity exceeded).
+int64_t tfr_scan_partial(const uint8_t* buf, uint64_t len, int32_t verify,
+                         uint64_t* offsets, uint64_t* lengths, int64_t cap,
+                         uint64_t* consumed);
+
+// Strict scan: the whole buffer must be complete frames. Returns record
+// count, or -1 (corrupt length crc), -2 (truncated), -3 (bad data crc),
+// -4 (capacity exceeded). Implemented as partial scan + completeness check
+// so the framing/CRC contract lives in one place.
 int64_t tfr_scan(const uint8_t* buf, uint64_t len, int32_t verify,
                  uint64_t* offsets, uint64_t* lengths, int64_t cap) {
+  uint64_t consumed = 0;
+  int64_t n = tfr_scan_partial(buf, len, verify, offsets, lengths, cap, &consumed);
+  if (n < 0) return n;
+  if (consumed != len) return -2;
+  return n;
+}
+
+// Partial frame scan for slab streaming: like tfr_scan, but a record that
+// extends past the end of the buffer is NOT an error — scanning stops and
+// *consumed is set to the byte offset of that record's frame start, so the
+// caller can carry the tail into the next slab. CRC failures on complete
+// records still error.
+int64_t tfr_scan_partial(const uint8_t* buf, uint64_t len, int32_t verify,
+                         uint64_t* offsets, uint64_t* lengths, int64_t cap,
+                         uint64_t* consumed) {
   init_crc32c_table();
   uint64_t pos = 0;
   int64_t n = 0;
+  *consumed = 0;
   while (pos < len) {
-    if (pos + 12 > len) return -2;
+    if (pos + 12 > len) break;  // incomplete header -> tail
     uint64_t rec_len;
     std::memcpy(&rec_len, buf + pos, 8);
     uint32_t len_crc;
     std::memcpy(&len_crc, buf + pos + 8, 4);
     if (verify && masked_crc(buf + pos, 8) != len_crc) return -1;
     uint64_t start = pos + 12;
-    // Overflow-safe bounds check: a corrupt 8-byte length near UINT64_MAX
-    // must not wrap `start + rec_len + 4` back below `len`.
-    if (len - start < 4 || rec_len > len - start - 4) return -2;
+    if (len - start < 4 || rec_len > len - start - 4) break;  // tail
     if (verify) {
       uint32_t data_crc;
       std::memcpy(&data_crc, buf + start + rec_len, 4);
@@ -633,6 +652,7 @@ int64_t tfr_scan(const uint8_t* buf, uint64_t len, int32_t verify,
     lengths[n] = rec_len;
     n++;
     pos = start + rec_len + 4;
+    *consumed = pos;
   }
   return n;
 }

@@ -84,6 +84,8 @@ class TFRecordDataset:
         read_retries: int = 0,
         hash_buckets: Optional[Dict[str, int]] = None,
         pack: Optional[Dict[str, List[str]]] = None,
+        slab_bytes: int = 256 << 20,
+        max_record_bytes: int = 1 << 30,
         **option_kwargs: Any,
     ):
         self._reader = (
@@ -128,13 +130,16 @@ class TFRecordDataset:
         self.shuffle = shuffle
         self.seed = seed
         self.read_retries = read_retries
+        self.slab_bytes = max(1, slab_bytes)
+        self.max_record_bytes = max_record_bytes
 
     # -- chunked decode stream with positional accounting --------------------
     #
-    # Each shard is loaded (decompressed) into one buffer, frame-scanned in a
-    # single native call, and decoded in large chunks (one C++ call per
-    # chunk, GIL released). Chunks carry (epoch, cursor, start_offset) so any
-    # row boundary maps back to an exact resume position.
+    # Each shard streams as slabs of complete frames (bounded memory, tail
+    # carried between reads), each slab is decoded in large chunks (one C++
+    # call per chunk, GIL released). Chunks carry (epoch, cursor,
+    # start_offset) so any row boundary maps back to an exact resume
+    # position.
 
     def _decode_chunk(self, buf, offsets, lengths) -> ColumnarBatch:
         if self._native_decoder is not None:
@@ -144,29 +149,55 @@ class TFRecordDataset:
         ]
         return self._decoder.decode_batch(records)
 
-    def _shard_spans(self, shard) -> tuple:
-        """Load one shard fully and return (buf, offsets, lengths), with
-        shard-level retry for transient IO/corruption failures (SURVEY.md §5
-        failure-handling plan; the reference leans on Spark task retry)."""
-        attempt = 0
-        while True:
-            try:
-                codec = wire.codec_from_path(shard.path)
-                with wire.open_compressed(shard.path, "rb", codec) as fh:
-                    buf = fh.read()
-                if not buf:
-                    return buf, np.empty(0, np.uint64), np.empty(0, np.uint64)
+    def _shard_slabs(self, shard) -> Iterator[tuple]:
+        """Stream one shard as (buf, offsets, lengths) slabs of complete
+        frames — shards larger than memory never materialize whole (the tail
+        of each read carries into the next slab). Compressed shards stream
+        through the codec the same way.
+
+        The tail carry is BOUNDED: once a partial frame header is visible,
+        the declared record length caps how much more is read (one read,
+        not repeated doubling), and a declared length above
+        ``max_record_bytes`` raises immediately — a corrupt length field
+        (possible with verify_crc=False) can never buffer the rest of a
+        huge shard before erroring."""
+        codec = wire.codec_from_path(shard.path)
+        verify = self.options.verify_crc
+        with wire.open_compressed(shard.path, "rb", codec) as fh:
+            carry = b""
+            while True:
+                want = self.slab_bytes
+                if len(carry) >= 8:
+                    # partial frame header: read exactly what it needs
+                    declared = int.from_bytes(carry[:8], "little")
+                    if declared > self.max_record_bytes:
+                        raise wire.TFRecordCorruptionError(
+                            f"record length {declared} exceeds max_record_bytes "
+                            f"({self.max_record_bytes}) in {shard.path} — "
+                            "corrupt length field?"
+                        )
+                    want = max(want, 16 + declared - len(carry))
+                data = fh.read(want)
+                if not data:
+                    if carry:
+                        raise wire.TFRecordCorruptionError(
+                            f"truncated TFRecord at end of {shard.path}"
+                        )
+                    return
+                buf = carry + data if carry else data
                 if _native.available():
-                    return (buf, *_native.scan(buf, self.options.verify_crc))
-                spans = list(wire.scan_buffer(buf, self.options.verify_crc))
-                offsets = np.array([s for s, _ in spans], dtype=np.uint64)
-                lengths = np.array([l for _, l in spans], dtype=np.uint64)
-                return buf, offsets, lengths
-            except (OSError, wire.TFRecordCorruptionError):
-                attempt += 1
-                if attempt > self.read_retries:
-                    raise
-                time.sleep(min(0.1 * 2**attempt, 2.0))
+                    offsets, lengths, consumed = _native.scan_partial(buf, verify)
+                else:
+                    spans, consumed = wire.scan_buffer_partial(buf, verify)
+                    offsets = np.array([s for s, _ in spans], dtype=np.uint64)
+                    lengths = np.array([l for _, l in spans], dtype=np.uint64)
+                if len(offsets) == 0:
+                    # not even one complete record yet: keep accumulating
+                    # (bounded by the declared-length check above)
+                    carry = buf
+                    continue
+                carry = buf[consumed:]
+                yield buf, offsets, lengths
 
     def epoch_order(self, epoch: int) -> List[int]:
         """Iteration order over this host's shard list for one epoch.
@@ -197,21 +228,44 @@ class TFRecordDataset:
             epoch += 1
 
     def _decode_shard(self, epoch: int, pos: int, shard_idx: int, skip: int) -> Iterator[tuple]:
-        """Decode one shard into chunk tuples (chunk, epoch, pos, start)."""
-        chunk_records = max(self.batch_size, 2048)
-        buf, offsets, lengths = self._shard_spans(self.shards[shard_idx])
-        n = len(offsets)
+        """Decode one shard into chunk tuples (chunk, epoch, pos, start).
+
+        Shard-level retry (SURVEY.md §5 failure-handling plan; the reference
+        leans on Spark task retry): on a transient IO/corruption error the
+        slab stream restarts, skipping the records already emitted — no
+        duplicates, no holes."""
         from tpu_tfrecord.tracing import trace
 
-        for start in range(skip, n, chunk_records):
-            stop = min(start + chunk_records, n)
-            with timed("decode", METRICS) as t, trace("tfr:decode"):
-                chunk = self._decode_chunk(buf, offsets[start:stop], lengths[start:stop])
-                t.records += chunk.num_rows
-                t.bytes += int(lengths[start:stop].sum())
-            if self._partition_fields:
-                self._attach_partition_chunk(chunk, shard_idx)
-            yield chunk, epoch, pos, start
+        chunk_records = max(self.batch_size, 2048)
+        next_index = skip  # record index within the shard to emit next
+        attempt = 0
+        while True:
+            try:
+                base = 0
+                for buf, offsets, lengths in self._shard_slabs(self.shards[shard_idx]):
+                    n = len(offsets)
+                    if base + n <= next_index:
+                        base += n
+                        continue
+                    for start in range(max(0, next_index - base), n, chunk_records):
+                        stop = min(start + chunk_records, n)
+                        with timed("decode", METRICS) as t, trace("tfr:decode"):
+                            chunk = self._decode_chunk(
+                                buf, offsets[start:stop], lengths[start:stop]
+                            )
+                            t.records += chunk.num_rows
+                            t.bytes += int(lengths[start:stop].sum())
+                        if self._partition_fields:
+                            self._attach_partition_chunk(chunk, shard_idx)
+                        yield chunk, epoch, pos, base + start
+                        next_index = base + stop
+                    base += n
+                return
+            except (OSError, wire.TFRecordCorruptionError):
+                attempt += 1
+                if attempt > self.read_retries:
+                    raise
+                time.sleep(min(0.1 * 2**attempt, 2.0))
 
     def _chunk_stream(self, state: IteratorState, stop_event=None) -> Iterator[tuple]:
         """Yield (chunk, epoch, position, start_offset) from the resume point

@@ -309,35 +309,50 @@ class RecordReader:
             yield rec
 
 
-def scan_buffer(
+def scan_buffer_partial(
     buf: bytes, verify_crc: bool = True
-) -> Iterator[Tuple[int, int]]:
-    """Yield (offset, length) of each record payload in an in-memory buffer.
-
-    This is the zero-copy scan used by the columnar fast path: the C++
-    extension implements the same contract over an mmap'd shard.
-    """
+) -> Tuple[List[Tuple[int, int]], int]:
+    """Scan complete frames in a buffer; a record extending past the end is
+    a TAIL (to carry into the next slab), not corruption. Returns
+    ([(offset, length), ...], consumed_bytes)."""
+    spans: List[Tuple[int, int]] = []
     pos = 0
     n = len(buf)
-    view = memoryview(buf)
+    consumed = 0
     while pos < n:
         if pos + HEADER_BYTES > n:
-            raise TFRecordCorruptionError("truncated TFRecord header")
+            break
         (length,) = _LEN_STRUCT.unpack_from(buf, pos)
         if verify_crc:
             (length_crc,) = _CRC_STRUCT.unpack_from(buf, pos + 8)
-            if masked_crc32c(bytes(view[pos : pos + 8])) != length_crc:
+            if masked_crc32c(buf[pos : pos + 8]) != length_crc:
                 raise TFRecordCorruptionError("corrupt TFRecord: bad length CRC")
         start = pos + HEADER_BYTES
-        end = start + length
-        if end + FOOTER_BYTES > n:
-            raise TFRecordCorruptionError("truncated TFRecord body")
+        if n - start < FOOTER_BYTES or length > n - start - FOOTER_BYTES:
+            break
         if verify_crc:
-            (data_crc,) = _CRC_STRUCT.unpack_from(buf, end)
-            if masked_crc32c(bytes(view[start:end])) != data_crc:
+            (data_crc,) = _CRC_STRUCT.unpack_from(buf, start + length)
+            if masked_crc32c(buf[start : start + length]) != data_crc:
                 raise TFRecordCorruptionError("corrupt TFRecord: bad data CRC")
-        yield start, length
-        pos = end + FOOTER_BYTES
+        spans.append((start, length))
+        pos = start + length + FOOTER_BYTES
+        consumed = pos
+    return spans, consumed
+
+
+def scan_buffer(
+    buf: bytes, verify_crc: bool = True
+) -> Iterator[Tuple[int, int]]:
+    """Yield (offset, length) of each record payload in an in-memory buffer;
+    a buffer that does not end on a frame boundary is corrupt.
+
+    Strict scan = partial scan + completeness check, so the framing/CRC
+    contract lives in exactly one place (same structure in the C++ twin).
+    """
+    spans, consumed = scan_buffer_partial(buf, verify_crc)
+    if consumed != len(buf):
+        raise TFRecordCorruptionError("truncated TFRecord")
+    yield from spans
 
 
 # ---------------------------------------------------------------------------
