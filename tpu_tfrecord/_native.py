@@ -119,9 +119,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.tfr_encode_batch.restype = ctypes.c_int64
     lib.tfr_encode_batch.argtypes = [
-        ctypes.c_int64, ctypes.c_int32,
-        ctypes.POINTER(ctypes.c_char_p), i64p, i32p, i32p,
-        ctypes.POINTER(u8p), ctypes.POINTER(i64p),
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_char_p), i64p, i32p, i32p, i32p,
+        ctypes.POINTER(u8p), ctypes.POINTER(i64p), ctypes.POINTER(i64p),
         ctypes.POINTER(u8p), ctypes.POINTER(i64p),
         ctypes.POINTER(u8p),
         u8p, ctypes.c_int64,
@@ -512,30 +512,41 @@ def hash_blob(blob: bytes, blob_offsets: np.ndarray, num_buckets: int) -> np.nda
 
 
 class NativeEncoder:
-    """Columnar batch -> framed tf.Example stream, one native call.
+    """Columnar batch -> framed tf.Example/SequenceExample stream, one
+    native call per batch.
 
     The write-side twin of NativeDecoder (reference write hot loop,
-    TFRecordOutputWriter.scala:26-38, done batch-at-a-time). Ragged2 /
-    SequenceExample stays on the Python path.
+    TFRecordOutputWriter.scala:26-38, done batch-at-a-time). For
+    SequenceExample, ragged2 columns become FeatureLists and scalar/ragged
+    columns go to the context map (mirroring TFRecordSerializer.scala:37-60).
     """
 
-    def __init__(self, schema: StructType):
+    def __init__(self, schema: StructType, record_type: RecordType = RecordType.EXAMPLE):
         lib = load()
         if lib is None:
             raise RuntimeError(f"native library unavailable: {_load_error}")
         self._lib = lib
         self.schema = schema
+        self.record_type = RecordType.parse(record_type)
+        if self.record_type == RecordType.BYTE_ARRAY:
+            raise UnsupportedSchemaError("ByteArray encode is trivial in Python")
         n = len(schema)
         specs = [_field_spec(f.name, f.data_type) for f in schema]
-        if any(s[0] == _LAYOUT_RAGGED2 for s in specs):
-            raise ValueError("array-of-array encode has no native path")
+        if self.record_type == RecordType.EXAMPLE and any(
+            s[0] == _LAYOUT_RAGGED2 for s in specs
+        ):
+            raise ValueError(
+                "array-of-array columns require recordType=SequenceExample"
+            )
         self._names = [f.name.encode("utf-8") for f in schema]
         self._c_names = (ctypes.c_char_p * n)(*self._names)
         self._name_lens = np.array([len(b) for b in self._names], dtype=np.int64)
-        self._layouts = [s[0] for s in specs]
+        self._layouts_np = np.array([s[0] for s in specs], dtype=np.int32)
+        self._layouts = self._layouts_np.tolist()  # single source of truth
         self._kinds = np.array([s[1] for s in specs], dtype=np.int32)
         self._dtypes = np.array([s[2] for s in specs], dtype=np.int32)
         self._non_nullable = [not f.nullable for f in schema]
+        self._fmt = 0 if self.record_type == RecordType.EXAMPLE else 1
 
     def encode_batch(self, batch: ColumnarBatch) -> np.ndarray:
         """Returns a uint8 array holding the framed record stream."""
@@ -545,6 +556,7 @@ class NativeEncoder:
         i64p = ctypes.POINTER(ctypes.c_int64)
         values_arr = (u8p * n_fields)()
         rowoff_arr = (i64p * n_fields)()
+        inneroff_arr = (i64p * n_fields)()
         blob_arr = (u8p * n_fields)()
         bloboff_arr = (i64p * n_fields)()
         mask_arr = (u8p * n_fields)()
@@ -561,6 +573,10 @@ class NativeEncoder:
                 ro = np.ascontiguousarray(col.offsets, dtype=np.int64)
                 keepalive.append(ro)
                 rowoff_arr[i] = ro.ctypes.data_as(i64p)
+            if self._layouts[i] == _LAYOUT_RAGGED2:
+                io_ = np.ascontiguousarray(col.inner_offsets, dtype=np.int64)
+                keepalive.append(io_)
+                inneroff_arr[i] = io_.ctypes.data_as(i64p)
             if int(self._dtypes[i]) == _DT_BYTES:
                 blob = col.blob if col.blob is not None else b""
                 keepalive.append(blob)
@@ -573,11 +589,12 @@ class NativeEncoder:
                 keepalive.append(v)
                 values_arr[i] = ctypes.cast(v.ctypes.data_as(ctypes.c_void_p), u8p)
         args = (
-            batch.num_rows, n_fields, self._c_names,
+            batch.num_rows, self._fmt, n_fields, self._c_names,
             self._name_lens.ctypes.data_as(i64p),
+            self._layouts_np.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             self._kinds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             self._dtypes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            values_arr, rowoff_arr, blob_arr, bloboff_arr, mask_arr,
+            values_arr, rowoff_arr, inneroff_arr, blob_arr, bloboff_arr, mask_arr,
         )
         size = lib.tfr_encode_batch(*args, None, 0)
         if size < 0:
@@ -590,13 +607,13 @@ class NativeEncoder:
 
 
 def make_encoder(schema: StructType, record_type) -> Optional["NativeEncoder"]:
-    """NativeEncoder if supported (Example only), else None."""
+    """NativeEncoder if supported, else None (Python row fallback)."""
     rt = RecordType.parse(record_type) if not isinstance(record_type, RecordType) else record_type
-    if rt != RecordType.EXAMPLE or not available():
+    if rt == RecordType.BYTE_ARRAY or not available():
         return None
     try:
-        return NativeEncoder(schema)
-    except ValueError:
+        return NativeEncoder(schema, rt)
+    except UnsupportedSchemaError:
         return None
 
 

@@ -859,10 +859,12 @@ inline void write_varint(uint8_t*& p, uint64_t v) {
 struct EncCol {
   const char* name;
   size_t name_len;
+  int32_t layout;              // LAYOUT_SCALAR / RAGGED / RAGGED2
   int32_t kind;
   int32_t dtype;
   const uint8_t* values;       // typed buffer
   const int64_t* row_offsets;  // null for scalar
+  const int64_t* inner_offsets;  // ragged2 only
   const uint8_t* blob;
   const int64_t* blob_offsets;
   const uint8_t* mask;         // null = all present
@@ -895,127 +897,197 @@ struct EncCol {
     }
     return sz;
   }
+
+  // Feature submessage (the `kind { values }` oneof) over a value range
+  inline uint64_t feature_msg_size(int64_t v0, int64_t v1) const {
+    uint64_t list_payload = list_payload_size(v0, v1);
+    uint64_t list_msg = (kind == KIND_BYTES)
+                            ? list_payload
+                            : (v1 > v0 ? 1 + varint_size(list_payload) + list_payload : 0);
+    return 1 + varint_size(list_msg) + list_msg;
+  }
+
+  inline void write_feature_msg(uint8_t*& p, int64_t v0, int64_t v1) const {
+    uint64_t list_payload = list_payload_size(v0, v1);
+    uint64_t list_msg = (kind == KIND_BYTES)
+                            ? list_payload
+                            : (v1 > v0 ? 1 + varint_size(list_payload) + list_payload : 0);
+    *p++ = (uint8_t)((kind << 3) | 2);  // oneof submessage tag
+    write_varint(p, list_msg);
+    if (kind == KIND_BYTES) {
+      for (int64_t v = v0; v < v1; v++) {
+        uint64_t blen = (uint64_t)(blob_offsets[v + 1] - blob_offsets[v]);
+        *p++ = 0x0A;  // value, field 1 LEN
+        write_varint(p, blen);
+        std::memcpy(p, blob + blob_offsets[v], blen);
+        p += blen;
+      }
+    } else if (v1 > v0) {
+      *p++ = 0x0A;  // packed values, field 1 LEN
+      write_varint(p, list_payload);
+      if (kind == KIND_INT64) {
+        if (dtype == DT_I64) {
+          const int64_t* vp = (const int64_t*)values;
+          for (int64_t v = v0; v < v1; v++) write_varint(p, (uint64_t)vp[v]);
+        } else {
+          const int32_t* vp = (const int32_t*)values;
+          for (int64_t v = v0; v < v1; v++) write_varint(p, (uint64_t)(int64_t)vp[v]);
+        }
+      } else {
+        if (dtype == DT_F32) {
+          std::memcpy(p, values + v0 * 4, (size_t)(v1 - v0) * 4);
+          p += (v1 - v0) * 4;
+        } else {  // f64 -> f32 downcast on the wire
+          const double* vp = (const double*)values;
+          for (int64_t v = v0; v < v1; v++) {
+            float f = (float)vp[v];
+            std::memcpy(p, &f, 4);
+            p += 4;
+          }
+        }
+      }
+    }
+  }
+
+  // FeatureList submessage (repeated Feature, one per inner list) for a
+  // ragged2 row spanning inner lists [j0, j1)
+  inline uint64_t featurelist_msg_size(int64_t j0, int64_t j1) const {
+    uint64_t sz = 0;
+    for (int64_t j = j0; j < j1; j++) {
+      uint64_t f = feature_msg_size(inner_offsets[j], inner_offsets[j + 1]);
+      sz += 1 + varint_size(f) + f;
+    }
+    return sz;
+  }
+
+  inline void write_featurelist_msg(uint8_t*& p, int64_t j0, int64_t j1) const {
+    for (int64_t j = j0; j < j1; j++) {
+      uint64_t f = feature_msg_size(inner_offsets[j], inner_offsets[j + 1]);
+      *p++ = 0x0A;  // FeatureList.feature, field 1 LEN
+      write_varint(p, f);
+      write_feature_msg(p, inner_offsets[j], inner_offsets[j + 1]);
+    }
+  }
+
+  // map entry (key + value submessage) wrapper
+  inline uint64_t entry_size(uint64_t value_msg) const {
+    return 1 + varint_size(name_len) + name_len + 1 + varint_size(value_msg) + value_msg;
+  }
+
+  inline void write_entry_header(uint8_t*& p, uint64_t value_msg) const {
+    *p++ = 0x0A;  // key, field 1 LEN
+    write_varint(p, name_len);
+    std::memcpy(p, name, name_len);
+    p += name_len;
+    *p++ = 0x12;  // value, field 2 LEN
+    write_varint(p, value_msg);
+  }
 };
 
 }  // namespace
 
 extern "C" {
 
-// Encode a batch of Examples. If out == nullptr, returns the exact total
-// framed size. Otherwise writes and returns bytes written (-1 if cap too
-// small, -2 on bad input).
+// Encode a batch of Example (record_format 0) or SequenceExample (1)
+// records from columnar buffers. For SequenceExample, ragged2 columns
+// become FeatureLists; scalar/ragged columns go to the context map. If
+// out == nullptr, returns the exact total framed size. Otherwise writes and
+// returns bytes written (-1 if cap too small, -2 on bad input).
 int64_t tfr_encode_batch(
-    int64_t n_records, int32_t n_fields,
+    int64_t n_records, int32_t record_format, int32_t n_fields,
     const char** field_names, const int64_t* name_lens,
-    const int32_t* kinds, const int32_t* dtypes,
+    const int32_t* layouts, const int32_t* kinds, const int32_t* dtypes,
     const uint8_t** values, const int64_t** row_offsets,
+    const int64_t** inner_offsets,
     const uint8_t** blobs, const int64_t** blob_offsets,
     const uint8_t** masks,
     uint8_t* out, int64_t cap) {
   init_crc32c_table();
   std::vector<EncCol> cols((size_t)n_fields);
   for (int32_t i = 0; i < n_fields; i++) {
-    cols[i] = EncCol{field_names[i], (size_t)name_lens[i], kinds[i], dtypes[i],
-                     values[i], row_offsets[i], blobs[i], blob_offsets[i], masks[i]};
+    cols[i] = EncCol{field_names[i], (size_t)name_lens[i], layouts[i],
+                     kinds[i], dtypes[i], values[i], row_offsets[i],
+                     inner_offsets[i], blobs[i], blob_offsets[i], masks[i]};
+    if (record_format == 0 && layouts[i] == LAYOUT_RAGGED2) return -2;
   }
   uint64_t total = 0;
   uint8_t* p = out;
+  // per-record scratch: each field's value-submessage size, computed once in
+  // the size pass and reused by the write pass
+  std::vector<uint64_t> msg_size((size_t)n_fields);
   for (int64_t r = 0; r < n_records; r++) {
     // ---- size pass for this record ----
-    uint64_t features_payload = 0;  // sum of map-entry fields
+    uint64_t features_payload = 0;   // context / Example features map
+    uint64_t lists_payload = 0;      // SequenceExample feature_lists map
     for (int32_t i = 0; i < n_fields; i++) {
       EncCol& c = cols[i];
       if (!c.present(r)) continue;
-      int64_t v0, v1;
-      c.value_range(r, &v0, &v1);
-      uint64_t list_payload = c.list_payload_size(v0, v1);
-      // list message (BytesList/FloatList/Int64List): for packed numeric,
-      // payload is wrapped as field 1 LEN; bytes entries are already tagged.
-      uint64_t list_msg = (c.kind == KIND_BYTES)
-                              ? list_payload
-                              : (v1 > v0 ? 1 + varint_size(list_payload) + list_payload : 0);
-      uint64_t feature_msg = 1 + varint_size(list_msg) + list_msg;  // kind tag
-      uint64_t entry = 1 + varint_size(c.name_len) + c.name_len      // key
-                       + 1 + varint_size(feature_msg) + feature_msg; // value
-      features_payload += 1 + varint_size(entry) + entry;            // entry tag
+      if (c.layout == LAYOUT_RAGGED2) {
+        int64_t j0 = c.row_offsets[r], j1 = c.row_offsets[r + 1];
+        uint64_t fl = msg_size[i] = c.featurelist_msg_size(j0, j1);
+        uint64_t entry = c.entry_size(fl);
+        lists_payload += 1 + varint_size(entry) + entry;
+      } else {
+        int64_t v0, v1;
+        c.value_range(r, &v0, &v1);
+        uint64_t f = msg_size[i] = c.feature_msg_size(v0, v1);
+        uint64_t entry = c.entry_size(f);
+        features_payload += 1 + varint_size(entry) + entry;
+      }
     }
-    uint64_t example = features_payload
-                           ? 1 + varint_size(features_payload) + features_payload
-                           : 0;
-    uint64_t framed = 16 + example;
+    uint64_t body;
+    if (record_format == 0) {
+      body = features_payload
+                 ? 1 + varint_size(features_payload) + features_payload
+                 : 0;
+    } else {
+      // SequenceExample always carries both submessages (reference
+      // serializer sets context and featureLists unconditionally)
+      body = 1 + varint_size(features_payload) + features_payload +
+             1 + varint_size(lists_payload) + lists_payload;
+    }
+    uint64_t framed = 16 + body;
     total += framed;
     if (out == nullptr) continue;
     if ((int64_t)(p - out) + (int64_t)framed > cap) return -1;
 
     // ---- write pass ----
     uint8_t* rec_start = p;
-    uint64_t ex_len = example;
-    std::memcpy(p, &ex_len, 8);
+    std::memcpy(p, &body, 8);
     uint32_t lcrc = masked_crc(p, 8);
     std::memcpy(p + 8, &lcrc, 4);
     p += 12;
     uint8_t* data_start = p;
-    if (features_payload) {
-      *p++ = 0x0A;  // Example.features, field 1 LEN
+    if (record_format != 0 || features_payload) {
+      *p++ = 0x0A;  // features / context, field 1 LEN
       write_varint(p, features_payload);
       for (int32_t i = 0; i < n_fields; i++) {
         EncCol& c = cols[i];
-        if (!c.present(r)) continue;
+        if (!c.present(r) || c.layout == LAYOUT_RAGGED2) continue;
         int64_t v0, v1;
         c.value_range(r, &v0, &v1);
-        uint64_t list_payload = c.list_payload_size(v0, v1);
-        uint64_t list_msg = (c.kind == KIND_BYTES)
-                                ? list_payload
-                                : (v1 > v0 ? 1 + varint_size(list_payload) + list_payload : 0);
-        uint64_t feature_msg = 1 + varint_size(list_msg) + list_msg;
-        uint64_t entry = 1 + varint_size(c.name_len) + c.name_len
-                         + 1 + varint_size(feature_msg) + feature_msg;
+        uint64_t f = msg_size[i];
         *p++ = 0x0A;  // map entry, field 1 LEN
-        write_varint(p, entry);
-        *p++ = 0x0A;  // key, field 1 LEN
-        write_varint(p, c.name_len);
-        std::memcpy(p, c.name, c.name_len);
-        p += c.name_len;
-        *p++ = 0x12;  // value (Feature), field 2 LEN
-        write_varint(p, feature_msg);
-        *p++ = (uint8_t)((c.kind << 3) | 2);  // kind submessage tag
-        write_varint(p, list_msg);
-        if (c.kind == KIND_BYTES) {
-          for (int64_t v = v0; v < v1; v++) {
-            uint64_t blen = (uint64_t)(c.blob_offsets[v + 1] - c.blob_offsets[v]);
-            *p++ = 0x0A;  // value, field 1 LEN
-            write_varint(p, blen);
-            std::memcpy(p, c.blob + c.blob_offsets[v], blen);
-            p += blen;
-          }
-        } else if (v1 > v0) {
-          *p++ = 0x0A;  // packed values, field 1 LEN
-          write_varint(p, list_payload);
-          if (c.kind == KIND_INT64) {
-            if (c.dtype == DT_I64) {
-              const int64_t* vp = (const int64_t*)c.values;
-              for (int64_t v = v0; v < v1; v++) write_varint(p, (uint64_t)vp[v]);
-            } else {
-              const int32_t* vp = (const int32_t*)c.values;
-              for (int64_t v = v0; v < v1; v++) write_varint(p, (uint64_t)(int64_t)vp[v]);
-            }
-          } else {
-            if (c.dtype == DT_F32) {
-              std::memcpy(p, c.values + v0 * 4, (size_t)(v1 - v0) * 4);
-              p += (v1 - v0) * 4;
-            } else {  // f64 -> f32 downcast on the wire
-              const double* vp = (const double*)c.values;
-              for (int64_t v = v0; v < v1; v++) {
-                float f = (float)vp[v];
-                std::memcpy(p, &f, 4);
-                p += 4;
-              }
-            }
-          }
-        }
+        write_varint(p, c.entry_size(f));
+        c.write_entry_header(p, f);
+        c.write_feature_msg(p, v0, v1);
       }
     }
-    uint32_t dcrc = masked_crc(data_start, ex_len);
+    if (record_format != 0) {
+      *p++ = 0x12;  // feature_lists, field 2 LEN
+      write_varint(p, lists_payload);
+      for (int32_t i = 0; i < n_fields; i++) {
+        EncCol& c = cols[i];
+        if (!c.present(r) || c.layout != LAYOUT_RAGGED2) continue;
+        int64_t j0 = c.row_offsets[r], j1 = c.row_offsets[r + 1];
+        uint64_t fl = msg_size[i];
+        *p++ = 0x0A;  // map entry, field 1 LEN
+        write_varint(p, c.entry_size(fl));
+        c.write_entry_header(p, fl);
+        c.write_featurelist_msg(p, j0, j1);
+      }
+    }
+    uint32_t dcrc = masked_crc(data_start, body);
     std::memcpy(p, &dcrc, 4);
     p += 4;
     if ((uint64_t)(p - rec_start) != framed) return -2;  // size/write mismatch

@@ -243,17 +243,20 @@ def _write_batches(
     writer: "DatasetWriter", batches, task_id: int = 0
 ) -> List[str]:
     """Columnar write job: one native encode call per batch (the fast write
-    path; falls back to per-row encoding when the schema has no native
-    encoder). Non-partitioned only — partitionBy routes per row."""
+    path for Example AND SequenceExample; falls back to per-row encoding
+    when the schema has no native encoder). Non-partitioned only —
+    partitionBy routes per row."""
     from tpu_tfrecord import _native
     from tpu_tfrecord.columnar import batch_to_rows, slice_batch
 
     if writer.partition_by:
         raise ValueError("write_batches does not support partition_by; use rows")
+    # Build the encoder FIRST: a schema/record-type config error must raise
+    # before any filesystem mutation (overwrite deletion, temp dirs).
+    encoder = _native.make_encoder(writer.data_schema, writer.options.record_type)
     if not writer._prepare_output():
         return []
     job = _WriteJob(writer, task_id)
-    encoder = _native.make_encoder(writer.data_schema, writer.options.record_type)
     max_per_file = writer.max_records_per_file
     current: Optional[ShardWriter] = None
     try:
