@@ -147,12 +147,60 @@ class TestColumnarWrite:
         with pytest.raises(NullValueError):
             w.write_batches([batch])
 
-    def test_partitioned_write_batches_rejected(self, sandbox):
-        schema = StructType([StructField("x", LongType()), StructField("p", LongType())])
-        w = DatasetWriter(str(sandbox / "p"), schema, TFRecordOptions(),
-                          mode="overwrite", partition_by=["p"])
-        with pytest.raises(ValueError, match="partition_by"):
-            w.write_batches([])
+    def test_partitioned_columnar_write(self, sandbox):
+        import os
+
+        schema = StructType(
+            [StructField("x", LongType()), StructField("day", StringType())]
+        )
+        rows = [[i, "a" if i < 6 else "b"] for i in range(10)]
+        ser = TFRecordSerializer(schema)
+        records = [encode_row(ser, RecordType.EXAMPLE, r) for r in rows]
+        batch = ColumnarDecoder(schema).decode_batch(records)
+        out = str(sandbox / "pcw")
+        w = DatasetWriter(out, schema, TFRecordOptions(), mode="overwrite",
+                          partition_by=["day"])
+        files = w.write_batches([batch])
+        assert sorted(d for d in os.listdir(out) if d != "_SUCCESS") == [
+            "day=a", "day=b",
+        ]
+        t = tfio.read(out)
+        got = sorted(t.to_dicts(), key=lambda d: d["x"])
+        assert [d["day"] for d in got] == ["a"] * 6 + ["b"] * 4
+        assert [d["x"] for d in got] == list(range(10))
+
+    def test_partitioned_columnar_interleaved_keys(self, sandbox):
+        schema = StructType(
+            [StructField("x", LongType()), StructField("k", LongType())]
+        )
+        rows = [[i, i % 3] for i in range(12)]  # worst case: alternating keys
+        ser = TFRecordSerializer(schema)
+        records = [encode_row(ser, RecordType.EXAMPLE, r) for r in rows]
+        batch = ColumnarDecoder(schema).decode_batch(records)
+        out = str(sandbox / "pci")
+        DatasetWriter(out, schema, TFRecordOptions(), mode="overwrite",
+                      partition_by=["k"]).write_batches([batch])
+        t = tfio.read(out)
+        assert sorted(t.column("x")) == list(range(12))
+        assert sorted(set(t.column("k"))) == [0, 1, 2]
+
+    def test_partitioned_columnar_null_key(self, sandbox):
+        import os
+
+        schema = StructType(
+            [StructField("x", LongType()), StructField("day", StringType())]
+        )
+        rows = [[1, "a"], [2, None]]
+        ser = TFRecordSerializer(schema)
+        records = [encode_row(ser, RecordType.EXAMPLE, r) for r in rows]
+        batch = ColumnarDecoder(schema).decode_batch(records)
+        out = str(sandbox / "pcn")
+        DatasetWriter(out, schema, TFRecordOptions(), mode="overwrite",
+                      partition_by=["day"]).write_batches([batch])
+        assert os.path.isdir(os.path.join(out, "day=__HIVE_DEFAULT_PARTITION__"))
+        t = tfio.read(out)
+        got = sorted(t.to_dicts(), key=lambda d: d["x"])
+        assert got[1]["day"] is None
 
     def test_decimal_column_batch_write(self, sandbox):
         schema = StructType([StructField("dec", DecimalType())])
@@ -242,3 +290,47 @@ class TestSequenceExampleColumnarWrite:
         with pytest.raises(ValueError, match="SequenceExample"):
             w.write_batches([])
         assert sorted(os.listdir(out)) == files_before  # nothing touched
+
+    def test_config_errors_before_overwrite_deletion(self, sandbox):
+        """Ragged partition col / missing batch column must not destroy an
+        existing dataset under mode=overwrite (review regression)."""
+        import os
+
+        out = str(sandbox / "keep")
+        keep_schema = StructType([StructField("x", LongType())])
+        tfio.write([[1]], keep_schema, out, mode="overwrite")
+        before = sorted(os.listdir(out))
+        # ragged partition column: rejected at constructor time
+        rag = StructType([StructField("x", LongType()),
+                          StructField("a", ArrayType(LongType()))])
+        with pytest.raises(ValueError, match="cannot be an array"):
+            DatasetWriter(out, rag, TFRecordOptions(), mode="overwrite",
+                          partition_by=["a"])
+        # batch missing the partition column: rejected before deletion
+        schema = StructType([StructField("x", LongType()), StructField("k", LongType())])
+        ser = TFRecordSerializer(keep_schema)
+        b = ColumnarDecoder(keep_schema).decode_batch(
+            [encode_row(ser, RecordType.EXAMPLE, [5])]
+        )
+        w = DatasetWriter(out, schema, TFRecordOptions(), mode="overwrite",
+                          partition_by=["k"])
+        with pytest.raises(ValueError, match="not present in"):
+            w.write_batches([b])
+        assert sorted(os.listdir(out)) == before
+
+    def test_binary_partition_value_matches_row_path(self, sandbox):
+        import os
+
+        schema = StructType([StructField("x", LongType()),
+                             StructField("b", BinaryType())])
+        rows = [[1, b"\xff\xfe"], [2, b"ok"]]
+        out_rows = str(sandbox / "rowp")
+        tfio.write(rows, schema, out_rows, mode="overwrite", partition_by=["b"])
+        ser = TFRecordSerializer(schema)
+        batch = ColumnarDecoder(schema).decode_batch(
+            [encode_row(ser, RecordType.EXAMPLE, r) for r in rows]
+        )
+        out_cols = str(sandbox / "colp")
+        DatasetWriter(out_cols, schema, TFRecordOptions(), mode="overwrite",
+                      partition_by=["b"]).write_batches([batch])
+        assert sorted(os.listdir(out_rows)) == sorted(os.listdir(out_cols))

@@ -92,6 +92,10 @@ class DatasetWriter:
         for col in self.partition_by:
             if col not in schema:
                 raise ValueError(f"partitionBy column {col!r} not in schema")
+            from tpu_tfrecord.schema import ArrayType as _AT
+
+            if isinstance(schema[col].data_type, _AT):
+                raise ValueError(f"partition column {col!r} cannot be an array")
         if self.partition_by and len(self.partition_by) == len(schema):
             raise ValueError("cannot use all columns as partition columns")
         # Partition columns are stripped from the written records — the data
@@ -179,8 +183,10 @@ class DatasetWriter:
         os.replace(tmp_path, final_path)
 
     def write_batches(self, batches, task_id: int = 0) -> List[str]:
-        """Write ColumnarBatches (the fast columnar path; Example only,
-        non-partitioned). See module docstring for save-mode semantics."""
+        """Write ColumnarBatches (the fast columnar path for Example and
+        SequenceExample). With partition_by, batches must contain the
+        partition columns; consecutive equal-key runs route to their
+        ``col=value`` dirs. See module docstring for save-mode semantics."""
         return _write_batches(self, batches, task_id)
 
 
@@ -239,62 +245,121 @@ class _WriteJob:
         shutil.rmtree(self.temp_root, ignore_errors=True)
 
 
+def _partition_runs(batch, writer: "DatasetWriter"):
+    """Yield (rel_dir, start, stop) runs of consecutive rows sharing the same
+    partition values. Pre-clustered input (the common case for re-partition
+    jobs) yields few large runs; fully interleaved keys degenerate to
+    per-row runs — correct either way."""
+    cols = []
+    for name in writer.partition_by:
+        col = batch[name]
+        if col.blob is not None:
+            # keep raw bytes: p.format_partition_value renders them with the
+            # same lossy utf-8 handling as the row path
+            blobs = col.blobs
+            vals = [
+                (blobs[i] if col.mask is None or col.mask[i] else None)
+                for i in range(batch.num_rows)
+            ]
+        else:
+            raw = col.values
+            vals = [
+                (raw[i].item() if col.mask is None or col.mask[i] else None)
+                for i in range(batch.num_rows)
+            ]
+        cols.append(vals)
+    start = 0
+    n = batch.num_rows
+    for r in range(1, n + 1):
+        if r == n or any(c[r] != c[start] for c in cols):
+            values = [c[start] for c in cols]
+            yield p.partition_dir(writer.partition_by, values), start, r
+            start = r
+
+
 def _write_batches(
     writer: "DatasetWriter", batches, task_id: int = 0
 ) -> List[str]:
-    """Columnar write job: one native encode call per batch (the fast write
+    """Columnar write job: one native encode call per run (the fast write
     path for Example AND SequenceExample; falls back to per-row encoding
-    when the schema has no native encoder). Non-partitioned only —
-    partitionBy routes per row."""
+    when the schema has no native encoder). With partition_by, partition
+    columns are stripped and consecutive equal-key runs route to their
+    ``col=value`` directories."""
     from tpu_tfrecord import _native
-    from tpu_tfrecord.columnar import batch_to_rows, slice_batch
+    from tpu_tfrecord.columnar import ColumnarBatch, batch_to_rows, slice_batch
 
-    if writer.partition_by:
-        raise ValueError("write_batches does not support partition_by; use rows")
-    # Build the encoder FIRST: a schema/record-type config error must raise
-    # before any filesystem mutation (overwrite deletion, temp dirs).
+    # Config errors must raise BEFORE any filesystem mutation (overwrite
+    # deletion, temp dirs): build the encoder and peek the first batch for
+    # missing partition columns up front.
     encoder = _native.make_encoder(writer.data_schema, writer.options.record_type)
+    import itertools
+
+    batches = iter(batches)
+    first = next(batches, None)
+    if first is not None and writer.partition_by:
+        missing = [c for c in writer.partition_by if c not in first.columns]
+        if missing:
+            raise ValueError(
+                f"write_batches: partition columns {missing} not present in "
+                f"the batch (have {sorted(first.columns)})"
+            )
+    batches = itertools.chain([first], batches) if first is not None else iter(())
     if not writer._prepare_output():
         return []
     job = _WriteJob(writer, task_id)
     max_per_file = writer.max_records_per_file
-    current: Optional[ShardWriter] = None
+    writers: Dict[str, ShardWriter] = {}
+    data_names = set(writer.data_schema.names)
+
+    def emit(rel: str, part, t) -> None:
+        pos = 0
+        while pos < part.num_rows:
+            w = writers.get(rel)
+            if w is not None and max_per_file and w.records_written >= max_per_file:
+                job.retire(writers.pop(rel))
+                w = None
+            if w is None:
+                w = writers[rel] = job.new_shard(rel)
+            room = (
+                max_per_file - w.records_written
+                if max_per_file
+                else part.num_rows - pos
+            )
+            take = min(room, part.num_rows - pos)
+            piece = (
+                part
+                if (pos == 0 and take == part.num_rows)
+                else slice_batch(part, pos, pos + take)
+            )
+            if encoder is not None:
+                framed = encoder.encode_batch(piece)
+                # zero-copy view; file objects accept any buffer
+                w.write_framed(framed.data, piece.num_rows)
+            else:
+                for row in batch_to_rows(piece, writer.data_schema):
+                    w.write(row)
+            t.records += piece.num_rows
+            pos += take
+
     try:
         with timed("write", METRICS) as t:
             for batch in batches:
-                pos = 0
-                while pos < batch.num_rows:
-                    if current is None:
-                        current = job.new_shard()
-                    room = (
-                        max_per_file - current.records_written
-                        if max_per_file
-                        else batch.num_rows - pos
-                    )
-                    take = min(room, batch.num_rows - pos)
-                    part = (
-                        batch
-                        if (pos == 0 and take == batch.num_rows)
-                        else slice_batch(batch, pos, pos + take)
-                    )
-                    if encoder is not None:
-                        framed = encoder.encode_batch(part)
-                        # zero-copy view; file objects accept any buffer
-                        current.write_framed(framed.data, part.num_rows)
-                    else:
-                        for row in batch_to_rows(part, writer.data_schema):
-                            current.write(row)
-                    t.records += part.num_rows
-                    pos += take
-                    if max_per_file and current.records_written >= max_per_file:
-                        job.retire(current)
-                        current = None
-        if current is not None:
-            job.retire(current)
+                if not writer.partition_by:
+                    emit("", batch, t)
+                    continue
+                # strip partition columns; route runs to their directories
+                data_batch = ColumnarBatch(
+                    {k: v for k, v in batch.columns.items() if k in data_names},
+                    batch.num_rows,
+                )
+                for rel, start, stop in _partition_runs(batch, writer):
+                    emit(rel, slice_batch(data_batch, start, stop), t)
+        for w in writers.values():
+            job.retire(w)
     except Exception:
-        if current is not None:
+        for w in writers.values():
             try:
-                current.close()
+                w.close()
             except Exception:
                 pass
         job.abort()
