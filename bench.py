@@ -28,7 +28,7 @@ import numpy as np
 
 N_SHARDS = 16
 RECORDS_PER_SHARD = 8192
-BATCH_SIZE = 8192
+BATCH_SIZE = int(os.environ.get("TFR_BENCH_BATCH", 8192))
 HASH_BUCKETS = 1 << 20
 WARMUP_BATCHES = 3
 MEASURE_SECONDS = 12.0

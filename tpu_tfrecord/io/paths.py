@@ -14,7 +14,7 @@ import glob as _glob
 import os
 import re
 import uuid
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from tpu_tfrecord.schema import DataType, DoubleType, LongType, StringType

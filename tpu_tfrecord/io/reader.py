@@ -14,9 +14,8 @@ explicit), with Spark-style type inference (long -> double -> string).
 
 from __future__ import annotations
 
-import os
 import time
-from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 from tpu_tfrecord import wire
 from tpu_tfrecord.infer import infer_from_records, merge_type_maps, type_map_to_schema

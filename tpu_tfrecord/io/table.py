@@ -10,7 +10,7 @@ tests, inspection, small exports).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Iterator, List, Optional, Sequence
+from typing import Any, Iterator, List, Sequence
 
 from tpu_tfrecord.schema import StructType
 

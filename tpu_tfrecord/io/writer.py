@@ -22,12 +22,12 @@ from __future__ import annotations
 import os
 import shutil
 import uuid
-from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 from tpu_tfrecord import wire
 from tpu_tfrecord.io import paths as p
 from tpu_tfrecord.metrics import METRICS, timed
-from tpu_tfrecord.options import RecordType, TFRecordOptions
+from tpu_tfrecord.options import TFRecordOptions
 from tpu_tfrecord.schema import StructType
 from tpu_tfrecord.serde import TFRecordSerializer, encode_row
 

@@ -20,7 +20,7 @@ for a Criteo-like schema: 'dense' [B, 13] f32, 'cat' [B, 26] i64 (hashed),
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
 
 import jax

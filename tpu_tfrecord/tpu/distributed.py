@@ -19,13 +19,13 @@ parallelism table, §5). The TPU-native equivalents:
 from __future__ import annotations
 
 import json
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 import jax
 import numpy as np
 
 from tpu_tfrecord.infer import TypeMap, merge_type_maps, type_map_to_schema
-from tpu_tfrecord.schema import DataType, StructType, data_type_from_json
+from tpu_tfrecord.schema import StructType, data_type_from_json
 
 
 def initialize(

@@ -11,7 +11,7 @@ stays off the critical path (the >=95% duty-cycle target, BASELINE.md).
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterable, Iterator, List, Optional, Union
+from typing import Dict, Iterable, Iterator, List, Optional, Union
 
 import jax
 import numpy as np
