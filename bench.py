@@ -30,8 +30,8 @@ N_SHARDS = 16
 RECORDS_PER_SHARD = 8192
 BATCH_SIZE = int(os.environ.get("TFR_BENCH_BATCH", 8192))
 HASH_BUCKETS = 1 << 20
-WARMUP_BATCHES = 3
-MEASURE_SECONDS = 12.0
+WARMUP_BATCHES = 4
+MEASURE_SECONDS = float(os.environ.get("TFR_BENCH_SECONDS", 15.0))
 
 
 def criteo_schema():

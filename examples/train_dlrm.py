@@ -27,6 +27,7 @@ import optax
 from tpu_tfrecord import checkpoint
 from tpu_tfrecord.io.dataset import TFRecordDataset
 from tpu_tfrecord.metrics import METRICS
+from tpu_tfrecord.tracing import DutyCycle
 from tpu_tfrecord.models import DLRMConfig, init_params, train_step
 from tpu_tfrecord.schema import LongType, StringType, StructField, StructType
 from tpu_tfrecord.serde import TFRecordSerializer, encode_row
@@ -102,18 +103,36 @@ def main() -> None:
         data_dir, batch_size=BATCH, schema=schema, num_epochs=2, shuffle=True, seed=0
     )
     step = 0
+    duty = DutyCycle()
+    prev_loss = None
     t0 = time.perf_counter()
     with ds.batches(resume) as it:
-        for cb in it:
-            hb = host_batch_from_columnar(cb, ds.schema, hash_buckets=hash_buckets, pack=pack)
-            # standard Criteo dense preprocessing: log(1+x)
-            hb["dense"] = np.log1p(hb["dense"].clip(min=0)).astype(np.float32)
-            hb["label"] = hb["label"].astype(np.float32)
-            gb = make_global_batch(hb, mesh)
-            params, opt_state, loss = step_fn(params, opt_state, gb)
+        while True:
+            # wait window covers EVERYTHING the host does between steps,
+            # including blocking on the prefetch queue — otherwise the duty
+            # cycle inflates exactly when the input pipeline is the
+            # bottleneck.
+            with duty.wait():
+                cb = next(it, None)
+                if cb is not None:
+                    hb = host_batch_from_columnar(cb, ds.schema, hash_buckets=hash_buckets, pack=pack)
+                    # standard Criteo dense preprocessing: log(1+x)
+                    hb["dense"] = np.log1p(hb["dense"].clip(min=0)).astype(np.float32)
+                    hb["label"] = hb["label"].astype(np.float32)
+                    gb = make_global_batch(hb, mesh)
+            # one-deep pipeline: block on the PREVIOUS step inside the busy
+            # window (its device time), then dispatch the next step async —
+            # host prep of batch N+1 overlaps device compute of batch N.
+            with duty.step():
+                if prev_loss is not None:
+                    jax.block_until_ready(prev_loss)
+                if cb is not None:
+                    params, opt_state, prev_loss = step_fn(params, opt_state, gb)
+            if cb is None:
+                break
             step += 1
-            if step % 8 == 0:
-                print(f"step {step}  loss {float(loss):.4f}")
+            if step % 8 == 0 and prev_loss is not None:
+                print(f"step {step}  loss ~{float(prev_loss):.4f}")
                 checkpoint.save_state(ckpt_dir, it, step=step)
     # The epoch budget is exhausted: clear the input state so the next run
     # starts a fresh pass instead of resuming into an empty stream.
@@ -122,6 +141,8 @@ def main() -> None:
         os.remove(state_file)
     dt = time.perf_counter() - t0
     print(f"done: {step} steps, {step * BATCH / dt:,.0f} examples/s")
+    if duty.value() is not None:
+        print(f"device duty cycle: {duty.value():.1%} (target >=95%)")
     print("stage throughput:", {k: round(v["records_per_sec"]) for k, v in METRICS.snapshot().items() if v["records"]})
 
 
