@@ -36,9 +36,7 @@ def random_schema(rng, record_type):
         base = SCALARS[int(rng.integers(0, len(SCALARS)))]()
         if r < 0.5:
             dt = base
-        elif r < 0.8:
-            dt = ArrayType(base)
-        elif record_type == RecordType.SEQUENCE_EXAMPLE:
+        elif r >= 0.8 and record_type == RecordType.SEQUENCE_EXAMPLE:
             dt = ArrayType(ArrayType(base))
         else:
             dt = ArrayType(base)
@@ -50,7 +48,8 @@ def random_value(rng, dt):
     if isinstance(dt, IntegerType):
         return int(rng.integers(-(2**31), 2**31))
     if isinstance(dt, LongType):
-        return int(rng.integers(-(2**62), 2**62))
+        # full int64 range including both boundaries
+        return int(rng.integers(-(2**63), 2**63 - 1, endpoint=True))
     if isinstance(dt, (FloatType, DoubleType)):
         return float(np.float32(rng.normal() * 100))
     if isinstance(dt, DecimalType):
@@ -98,15 +97,20 @@ def rows_close(a, b):
             assert va == vb
 
 
-@pytest.mark.parametrize("seed", range(25))
-@pytest.mark.parametrize("rt", [RecordType.EXAMPLE, RecordType.SEQUENCE_EXAMPLE])
-def test_fuzz_all_paths(seed, rt):
+def _make_case(seed, rt):
     rng = np.random.default_rng((seed, rt is RecordType.EXAMPLE))
     schema = random_schema(rng, rt)
     rows = [random_row(rng, schema) for _ in range(int(rng.integers(1, 30)))]
     ser = TFRecordSerializer(schema)
-    de = TFRecordDeserializer(schema)
     records = [encode_row(ser, rt, r) for r in rows]
+    return schema, rows, records
+
+
+@pytest.mark.parametrize("seed", range(25))
+@pytest.mark.parametrize("rt", [RecordType.EXAMPLE, RecordType.SEQUENCE_EXAMPLE])
+def test_fuzz_python_paths(seed, rt):
+    schema, rows, records = _make_case(seed, rt)
+    de = TFRecordDeserializer(schema)
 
     # 1. row serde round-trip: nulls come back as None, values survive (at
     # the wire's float32 precision for double/decimal)
@@ -114,27 +118,33 @@ def test_fuzz_all_paths(seed, rt):
         back = decode_record(de, rt, rec)
         rows_close(back, [normalize_value(v, f.data_type) for v, f in zip(row, schema)])
 
-    # 2. Python vs native columnar decode agree exactly
+    # 2. batch_to_rows agrees with the row deserializer
     py_batch = ColumnarDecoder(schema, rt).decode_batch(records)
-    if _native.available():
-        from tests.test_native import assert_batches_equal
-
-        nat_batch = _native.NativeDecoder(schema, rt).decode_batch(records)
-        assert_batches_equal(nat_batch, py_batch)
-
-        # 3. native encode -> decode round-trip preserves the batch
-        enc = _native.NativeEncoder(schema, rt)
-        framed = enc.encode_batch(nat_batch)
-        offsets, lengths = _native.scan(framed.tobytes())
-        back2 = _native.NativeDecoder(schema, rt).decode_spans(
-            framed.tobytes(), offsets, lengths
-        )
-        assert_batches_equal(back2, nat_batch)
-
-    # 4. batch_to_rows agrees with the row deserializer
     via_batch = batch_to_rows(py_batch, schema)
     for got, rec in zip(via_batch, records):
         rows_close(got, decode_record(de, rt, rec))
+
+
+@pytest.mark.skipif(
+    not _native.available(), reason=f"native lib unavailable: {_native.load_error()}"
+)
+@pytest.mark.parametrize("seed", range(25))
+@pytest.mark.parametrize("rt", [RecordType.EXAMPLE, RecordType.SEQUENCE_EXAMPLE])
+def test_fuzz_native_paths(seed, rt):
+    schema, rows, records = _make_case(seed, rt)
+    from tests.test_native import assert_batches_equal
+
+    # Python vs native columnar decode agree exactly
+    py_batch = ColumnarDecoder(schema, rt).decode_batch(records)
+    nat_batch = _native.NativeDecoder(schema, rt).decode_batch(records)
+    assert_batches_equal(nat_batch, py_batch)
+
+    # native encode -> decode round-trip preserves the batch
+    enc = _native.NativeEncoder(schema, rt)
+    buf = enc.encode_batch(nat_batch).tobytes()
+    offsets, lengths = _native.scan(buf)
+    back2 = _native.NativeDecoder(schema, rt).decode_spans(buf, offsets, lengths)
+    assert_batches_equal(back2, nat_batch)
 
 
 def normalize_value(v, dt):
