@@ -40,6 +40,10 @@ class DLRMConfig:
     seq_len: int = 0                # 0 = no sequence tower
     seq_dim: int = 0
     dtype: Any = jnp.bfloat16       # activation dtype (MXU-friendly)
+    # 'cat': concatenate bottom output + flattened embeddings (simple);
+    # 'dot': classic DLRM pairwise dot interaction over [bottom_out; embs]
+    #        (Pallas kernel on TPU; requires bottom_mlp[-1] == embed_dim)
+    interaction: str = "cat"
 
 
 def _dense_init(rng, fan_in: int, fan_out: int):
@@ -64,7 +68,18 @@ def init_params(rng: jax.Array, cfg: DLRMConfig) -> Dict[str, Any]:
         bottom.append(_dense_init(jax.random.fold_in(keys[1], i), fan, width))
         fan = width
     params["bottom"] = bottom
-    interact_dim = cfg.bottom_mlp[-1] + cfg.num_categorical * cfg.embed_dim
+    if cfg.interaction == "dot":
+        if cfg.bottom_mlp[-1] != cfg.embed_dim:
+            raise ValueError(
+                "interaction='dot' requires bottom_mlp[-1] == embed_dim "
+                f"(got {cfg.bottom_mlp[-1]} vs {cfg.embed_dim})"
+            )
+        n_feat = cfg.num_categorical + 1  # embeddings + bottom output
+        interact_dim = cfg.bottom_mlp[-1] + n_feat * (n_feat - 1) // 2
+    elif cfg.interaction == "cat":
+        interact_dim = cfg.bottom_mlp[-1] + cfg.num_categorical * cfg.embed_dim
+    else:
+        raise ValueError(f"unknown interaction {cfg.interaction!r}")
     if cfg.seq_len:
         interact_dim += cfg.embed_dim
         params["seq_proj"] = _dense_init(keys[3], cfg.seq_dim, cfg.embed_dim)
@@ -96,7 +111,14 @@ def forward(params: Dict[str, Any], batch: Dict[str, jax.Array], cfg: DLRMConfig
         batch["cat"][:, :, None, None],                      # [B, F, 1, 1]
         axis=2,
     )[:, :, 0, :]
-    feats = [bottom_out, emb.reshape(emb.shape[0], -1)]
+    if cfg.interaction == "dot":
+        from tpu_tfrecord.models.interaction import dot_interaction
+
+        stack = jnp.concatenate([bottom_out[:, None, :], emb], axis=1)
+        pairs = dot_interaction(stack)                       # [B, P]
+        feats = [bottom_out, pairs.astype(dt)]
+    else:
+        feats = [bottom_out, emb.reshape(emb.shape[0], -1)]
     if cfg.seq_len:
         frames = batch["frames"].astype(dt)                  # [B, L, D_in]
         proj = _mlp([params["seq_proj"]], frames, dt)        # [B, L, D]
