@@ -68,6 +68,25 @@ def main() -> None:
     uid = gb["uid"]
     global_sum = float(jax.jit(lambda x: x.sum())(uid))
 
+    # --- coordinated multi-host write: per-host shards, one _SUCCESS ---
+    from tpu_tfrecord.io.writer import DatasetWriter
+    from tpu_tfrecord.options import TFRecordOptions
+
+    out_dir = os.path.join(os.path.dirname(data_dir), "mh_out")
+    os.makedirs(out_dir, exist_ok=True)
+    local_rows = [[int(v) + 1000 * pid] for v in range(4)]
+    from tpu_tfrecord.schema import LongType, StructField, StructType
+
+    w_schema = StructType([StructField("uid", LongType())])
+    writer = DatasetWriter(
+        out_dir, w_schema, TFRecordOptions(), mode="append", write_success=False
+    )
+    writer.write_rows(local_rows, task_id=pid)
+    marker_before = os.path.exists(os.path.join(out_dir, "_SUCCESS"))
+    distributed.finalize_distributed_write(out_dir)
+    # the double barrier guarantees the marker exists once the call returns
+    marker_after = os.path.exists(os.path.join(out_dir, "_SUCCESS"))
+
     print(
         json.dumps(
             {
@@ -77,6 +96,8 @@ def main() -> None:
                 "global_shape": list(uid.shape),
                 "global_sum": global_sum,
                 "local_rows": int(hb["uid"].shape[0]),
+                "marker_before": marker_before,
+                "marker_after": marker_after,
             }
         )
     )

@@ -91,3 +91,10 @@ def test_two_process_schema_merge_and_global_batch(sandbox, tmp_path):
     # the global array spans both processes' rows
     assert a["global_shape"] == [16]
     assert a["global_sum"] == b["global_sum"]
+    # coordinated write: marker appears only after the global barrier, and
+    # the combined dataset contains every host's rows
+    assert not a["marker_before"] and not b["marker_before"]
+    assert a["marker_after"] and b["marker_after"]
+    out_dir = os.path.join(os.path.dirname(data), "mh_out")
+    combined = tfio.read(out_dir)
+    assert sorted(combined.column("uid")) == [0, 1, 2, 3, 1000, 1001, 1002, 1003]

@@ -512,3 +512,93 @@ class TestGroupPacking:
         np.testing.assert_array_equal(packed["g"].values, [[0, 0]])
         plain = _native.NativeDecoder(schema).decode_batch([record])
         assert plain["a"].values[0] == 0 and not plain["a"].mask[0]
+
+
+class TestMultiHotHashing:
+    """hash_buckets on ArrayType(String): ragged multi-hot categoricals."""
+
+    SCHEMA = StructType([StructField("tags", ArrayType(StringType())),
+                         StructField("x", LongType())])
+
+    def make_recs(self, n=30):
+        rng = np.random.default_rng(5)
+        recs = []
+        for k in range(n):
+            feats = {
+                "x": Feature.int64_list([k]),
+                "tags": Feature.bytes_list(
+                    [f"tag{int(v)}".encode() for v in rng.integers(0, 50, size=k % 5)]
+                ),
+            }
+            recs.append(encode_example(Example(features=feats)))
+        return recs
+
+    def test_fused_ragged_hash_matches_post_hoc(self):
+        from tpu_tfrecord.tpu.ingest import hash_bytes_column
+
+        recs = self.make_recs()
+        plain = _native.NativeDecoder(self.SCHEMA).decode_batch(recs)
+        want = hash_bytes_column(plain["tags"], 97)
+        fused = _native.NativeDecoder(self.SCHEMA, hash_buckets={"tags": 97}).decode_batch(recs)
+        assert fused["tags"].values.dtype == np.int32
+        np.testing.assert_array_equal(fused["tags"].offsets, plain["tags"].offsets)
+        np.testing.assert_array_equal(fused["tags"].values, want)
+
+    def test_host_batch_pads_multi_hot(self, sandbox):
+        import tpu_tfrecord.io as tfio
+        from tpu_tfrecord.io.dataset import TFRecordDataset
+        from tpu_tfrecord.tpu.ingest import batch_spec, host_batch_from_columnar
+
+        rows = [[["a", "b"], 0], [[], 1], [["c"], 2], [["a", "b", "c", "d", "e"], 3]]
+        out = str(sandbox / "mh")
+        tfio.write(rows, self.SCHEMA, out, mode="overwrite")
+        hb_spec = {"tags": 64}
+        pads = {"tags": 4}
+        ds = TFRecordDataset(out, batch_size=4, schema=self.SCHEMA,
+                             hash_buckets=hb_spec, drop_remainder=False)
+        with ds.batches() as it:
+            cb = next(it)
+        hb = host_batch_from_columnar(cb, ds.schema, pad_to=pads, hash_buckets=hb_spec)
+        assert hb["tags"].shape == (4, 4) and hb["tags"].dtype == np.int32
+        order = np.argsort(hb["x"])
+        np.testing.assert_array_equal(hb["tags_len"][order], [2, 0, 1, 4])  # 5 truncated
+        spec = batch_spec(ds.schema, 4, pad_to=pads, hash_buckets=hb_spec)
+        for k in hb:
+            assert spec[k].shape == hb[k].shape and spec[k].dtype == hb[k].dtype
+
+    def test_python_fallback_matches_fused(self, sandbox):
+        import tpu_tfrecord.io as tfio
+        from tpu_tfrecord.io.dataset import TFRecordDataset
+        from tpu_tfrecord.tpu.ingest import host_batch_from_columnar
+
+        rows = [[["u", "vv"], 0], [["w"], 1]]
+        out = str(sandbox / "pf")
+        tfio.write(rows, self.SCHEMA, out, mode="overwrite")
+        hb_spec, pads = {"tags": 16}, {"tags": 3}
+        ds = TFRecordDataset(out, batch_size=2, schema=self.SCHEMA,
+                             hash_buckets=hb_spec, drop_remainder=False)
+        with ds.batches() as it:
+            fused = host_batch_from_columnar(next(it), ds.schema, pad_to=pads,
+                                             hash_buckets=hb_spec)
+        # unfused (no hash at decode): host_batch hashes the blobs
+        ds2 = TFRecordDataset(out, batch_size=2, schema=self.SCHEMA,
+                              drop_remainder=False)
+        with ds2.batches() as it2:
+            plain = host_batch_from_columnar(next(it2), ds2.schema, pad_to=pads,
+                                             hash_buckets=hb_spec)
+        for k in fused:
+            np.testing.assert_array_equal(fused[k], plain[k])
+
+    def test_missing_pad_to_raises(self, sandbox):
+        import tpu_tfrecord.io as tfio
+        from tpu_tfrecord.io.dataset import TFRecordDataset
+        from tpu_tfrecord.tpu.ingest import host_batch_from_columnar
+
+        out = str(sandbox / "nopad")
+        tfio.write([[["a"], 0]], self.SCHEMA, out, mode="overwrite")
+        ds = TFRecordDataset(out, batch_size=1, schema=self.SCHEMA,
+                             hash_buckets={"tags": 8}, drop_remainder=False)
+        with ds.batches() as it:
+            cb = next(it)
+        with pytest.raises(ValueError, match="multi-hot"):
+            host_batch_from_columnar(cb, ds.schema, hash_buckets={"tags": 8})

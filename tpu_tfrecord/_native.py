@@ -281,7 +281,11 @@ def validate_hash_buckets(schema: StructType, hash_buckets) -> Dict[str, int]:
             raise ValueError(
                 f"hash_buckets[{name!r}]: no such data column (have {schema.names})"
             )
-        if not isinstance(schema[name].data_type, (StringType, BinaryType)):
+        dt = schema[name].data_type
+        # scalar bytes column (single-hot) or array-of-bytes (multi-hot)
+        if isinstance(dt, ArrayType):
+            dt = dt.element_type
+        if not isinstance(dt, (StringType, BinaryType)):
             raise ValueError(f"hash_buckets[{name!r}]: not a string/binary column")
         b = int(buckets)
         if b <= 0:

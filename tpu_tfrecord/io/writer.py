@@ -79,6 +79,7 @@ class DatasetWriter:
         partition_by: Optional[List[str]] = None,
         mode: str = "error",
         max_records_per_file: Optional[int] = None,
+        write_success: bool = True,
     ):
         mode = (mode or "error").lower()
         if mode not in SAVE_MODES:
@@ -88,6 +89,11 @@ class DatasetWriter:
         self.mode = mode
         self.partition_by = list(partition_by or [])
         self.max_records_per_file = max_records_per_file
+        # Multi-host jobs: each host commits its own shards with
+        # write_success=False and a distinct task_id, then
+        # tpu.distributed.finalize_distributed_write barriers and writes the
+        # dataset-level marker once (host 0).
+        self.write_success = write_success
         self.schema = schema
         for col in self.partition_by:
             if col not in schema:
@@ -201,7 +207,17 @@ class _WriteJob:
         self.task_id = task_id
         self.job_id = uuid.uuid4().hex[:12]
         self.temp_root = os.path.join(writer.output_path, p.TEMP_PREFIX, self.job_id)
-        os.makedirs(self.temp_root, exist_ok=True)
+        # Concurrent jobs share the _temporary parent and a finishing job
+        # opportunistically rmdirs it: makedirs can lose the race between
+        # creating the parent and the job dir — retry, it converges.
+        for _ in range(20):
+            try:
+                os.makedirs(self.temp_root, exist_ok=True)
+                break
+            except FileNotFoundError:
+                continue
+        else:
+            raise OSError(f"could not create job temp dir {self.temp_root}")
         self.ext = writer.options.file_extension()
         self._seq: Dict[str, int] = {}
         self._final_of: Dict[str, str] = {}
@@ -238,7 +254,8 @@ class _WriteJob:
             os.rmdir(os.path.join(self.writer.output_path, p.TEMP_PREFIX))
         except OSError:
             pass
-        p.write_success_marker(self.writer.output_path)
+        if self.writer.write_success:
+            p.write_success_marker(self.writer.output_path)
         return written
 
     def abort(self) -> None:

@@ -95,6 +95,29 @@ def merge_schema_across_hosts(local_type_map: TypeMap) -> StructType:
     return type_map_to_schema(merged)
 
 
+def finalize_distributed_write(output_path: str) -> None:
+    """Multi-host write commit: every host calls this after its own
+    DatasetWriter job committed its shards (each host writes with
+    ``task_id=jax.process_index()`` so part files never collide). All hosts
+    barrier, then host 0 alone writes the dataset-level ``_SUCCESS`` marker —
+    a reader seeing the marker is guaranteed every host's shards are in
+    place (the analog of Spark's driver-side job commit)."""
+    multi = jax.process_count() > 1
+    if multi:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"tfr_write_commit:{output_path}")
+    if jax.process_index() == 0:
+        from tpu_tfrecord.io.paths import write_success_marker
+
+        write_success_marker(output_path)
+    if multi:
+        # second barrier: when this returns on ANY host, the marker exists
+        # (on host 0's filesystem) — the postcondition downstream gating
+        # code relies on
+        multihost_utils.sync_global_devices(f"tfr_write_done:{output_path}")
+
+
 def assert_same_across_hosts(value: bytes, what: str = "value") -> None:
     """Cheap cross-host consistency check (e.g. schema JSON, shard-list
     digest) — catches divergent host state before it corrupts a run."""

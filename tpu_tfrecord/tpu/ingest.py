@@ -69,7 +69,15 @@ def batch_spec(
         dt = f.data_type
         if _is_bytes_like(dt):
             if f.name in hash_buckets:
-                spec[f.name] = jax.ShapeDtypeStruct((batch_size,), np.int32)
+                if isinstance(dt, ArrayType):  # multi-hot: [B, K] + lengths
+                    k = pad_to[f.name]
+                    spec[f.name] = jax.ShapeDtypeStruct((batch_size, k), np.int32)
+                    if include_lengths:
+                        spec[f.name + "_len"] = jax.ShapeDtypeStruct(
+                            (batch_size,), np.int32
+                        )
+                else:
+                    spec[f.name] = jax.ShapeDtypeStruct((batch_size,), np.int32)
             continue
         if isinstance(dt, ArrayType):
             if isinstance(dt.element_type, ArrayType):
@@ -157,7 +165,32 @@ def host_batch_from_columnar(
         if _is_bytes_like(dt):
             if f.name in hash_buckets:
                 if col.is_ragged:
-                    raise ValueError(f"{f.name}: hashing ragged bytes unsupported")
+                    # multi-hot categorical: ragged hashed indices pad to
+                    # [B, K] + lengths (consumers mask/pool over K)
+                    if f.name not in pad_to:
+                        raise ValueError(
+                            f"multi-hot column {f.name!r} requires pad_to[{f.name!r}]"
+                        )
+                    if col.values is not None:
+                        # fused: already int32 indices — bucket counts must
+                        # agree, same contract as the scalar path
+                        if (
+                            col.hash_buckets is not None
+                            and col.hash_buckets != hash_buckets[f.name]
+                        ):
+                            raise ValueError(
+                                f"{f.name}: decoded with hash_buckets="
+                                f"{col.hash_buckets} but host batch requests "
+                                f"{hash_buckets[f.name]}"
+                            )
+                        vals = col.values
+                    else:
+                        vals = hash_bytes_column(col, hash_buckets[f.name])
+                    dense, lengths = pad_ragged(vals, col.offsets, pad_to[f.name])
+                    out[f.name] = dense
+                    if include_lengths:
+                        out[f.name + "_len"] = lengths
+                    continue
                 if col.values is not None:
                     # already hashed during decode (fused native path)
                     if (
