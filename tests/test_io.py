@@ -327,3 +327,55 @@ class TestRegistry:
     def test_unknown_format(self):
         with pytest.raises(ValueError):
             lookup_format("parquet-nope")
+
+
+class TestSaveModeExistenceSemantics:
+    """Spark parity: an existing-but-empty directory counts as 'exists' for
+    error/ignore modes (path existence, not data-file presence)."""
+
+    def test_error_on_empty_existing_dir(self, sandbox):
+        out = str(sandbox / "emptydir")
+        os.makedirs(out)
+        with pytest.raises(FileExistsError):
+            tfio.write(ROWS, SCHEMA, out)  # default ErrorIfExists
+
+    def test_ignore_on_empty_existing_dir(self, sandbox):
+        out = str(sandbox / "emptydir2")
+        os.makedirs(out)
+        assert tfio.write(ROWS, SCHEMA, out, mode="ignore") == []
+        assert os.listdir(out) == []
+
+    def test_overwrite_and_append_on_empty_dir_proceed(self, sandbox):
+        out = str(sandbox / "emptydir3")
+        os.makedirs(out)
+        assert len(tfio.write(ROWS, SCHEMA, out, mode="overwrite")) > 0
+        out2 = str(sandbox / "emptydir4")
+        os.makedirs(out2)
+        assert len(tfio.write(ROWS, SCHEMA, out2, mode="append")) > 0
+
+    def test_failed_job_does_not_poison_retry(self, sandbox):
+        """A failed first write must not leave an empty output dir that
+        flips error/ignore semantics on retry (review regression)."""
+        out = str(sandbox / "retry")
+
+        def bad_rows():
+            yield ROWS[0]
+            raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError):
+            tfio.write(bad_rows(), SCHEMA, out)  # default mode=error
+        assert not os.path.exists(out)
+        # retry with fixed data now succeeds under the same mode
+        assert len(tfio.write(ROWS, SCHEMA, out)) > 0
+
+    def test_overwrite_preserves_other_jobs_temp(self, sandbox):
+        """Overwrite clears data but must not delete another job's in-flight
+        _temporary shards (review regression)."""
+        out = str(sandbox / "owtemp")
+        tfio.write(ROWS, SCHEMA, out, mode="overwrite")
+        other = os.path.join(out, "_temporary", "other-job")
+        os.makedirs(other)
+        open(os.path.join(other, "inflight.tmp"), "wb").close()
+        tfio.write(ROWS[:1], SCHEMA, out, mode="overwrite")
+        assert os.path.exists(os.path.join(other, "inflight.tmp"))
+        assert len(tfio.read(out, schema=SCHEMA)) == 1  # old data cleared

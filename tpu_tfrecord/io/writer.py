@@ -117,11 +117,10 @@ class DatasetWriter:
 
     def _prepare_output(self) -> bool:
         """Apply save-mode semantics. Returns False if the write is a no-op
-        (mode=ignore with existing output)."""
+        (mode=ignore with existing output). Existence means PATH existence —
+        an empty directory counts, matching Spark's save-mode checks."""
         out = self.output_path
-        exists = os.path.exists(out) and (
-            not os.path.isdir(out) or any(p.is_data_file(f) for f in os.listdir(out))
-        )
+        exists = os.path.exists(out)
         if exists:
             if self.mode in ("error", "errorifexists"):
                 raise FileExistsError(
@@ -131,9 +130,22 @@ class DatasetWriter:
                 return False
             if self.mode == "overwrite":
                 if os.path.isdir(out):
-                    shutil.rmtree(out)
+                    # delete data and markers but PRESERVE the _temporary
+                    # subtree: other jobs may have shards in flight there
+                    for entry in os.listdir(out):
+                        if entry == p.TEMP_PREFIX:
+                            continue
+                        fp = os.path.join(out, entry)
+                        if os.path.isdir(fp):
+                            shutil.rmtree(fp)
+                        else:
+                            os.remove(fp)
                 else:
                     os.remove(out)
+        # remember whether THIS job created the output dir so abort() can
+        # undo it — a leftover empty dir would flip error/ignore semantics
+        # on retry now that existence is path-based
+        self._created_output = not exists
         os.makedirs(out, exist_ok=True)
         return True
 
@@ -260,6 +272,17 @@ class _WriteJob:
 
     def abort(self) -> None:
         shutil.rmtree(self.temp_root, ignore_errors=True)
+        # if this job created the output dir, remove it again when empty so
+        # a retry sees the same save-mode world as the first attempt
+        if getattr(self.writer, "_created_output", False):
+            try:
+                os.rmdir(os.path.join(self.writer.output_path, p.TEMP_PREFIX))
+            except OSError:
+                pass
+            try:
+                os.rmdir(self.writer.output_path)
+            except OSError:
+                pass
 
 
 def _partition_runs(batch, writer: "DatasetWriter"):
