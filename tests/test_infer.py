@@ -146,3 +146,53 @@ class TestMergeAndErrors:
     def test_wrong_message_type_raises(self):
         with pytest.raises(SchemaInferenceError):
             infer_schema([SequenceExample()], RecordType.EXAMPLE)
+
+
+class TestMergeAlgebra:
+    """The distributed combOp must be commutative and associative — hosts
+    fold partial maps in different groupings; determinism depends on it."""
+
+    TYPES = [
+        None,
+        LongType(),
+        FloatType(),
+        StringType(),
+        ArrayType(LongType()),
+        ArrayType(FloatType()),
+        ArrayType(StringType()),
+        ArrayType(ArrayType(LongType())),
+        ArrayType(ArrayType(FloatType())),
+        ArrayType(ArrayType(StringType())),
+    ]
+
+    def random_map(self, rng):
+        return {
+            f"f{i}": self.TYPES[int(rng.integers(0, len(self.TYPES)))]
+            for i in range(int(rng.integers(0, 6)))
+        }
+
+    def test_commutative(self):
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        for _ in range(200):
+            a, b = self.random_map(rng), self.random_map(rng)
+            assert merge_type_maps(a, b) == merge_type_maps(b, a)
+
+    def test_associative(self):
+        import numpy as np
+
+        rng = np.random.default_rng(1)
+        for _ in range(200):
+            a, b, c = (self.random_map(rng) for _ in range(3))
+            left = merge_type_maps(merge_type_maps(a, b), c)
+            right = merge_type_maps(a, merge_type_maps(b, c))
+            assert left == right
+
+    def test_idempotent(self):
+        import numpy as np
+
+        rng = np.random.default_rng(2)
+        for _ in range(50):
+            a = self.random_map(rng)
+            assert merge_type_maps(a, a) == a

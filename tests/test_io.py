@@ -379,3 +379,29 @@ class TestSaveModeExistenceSemantics:
         tfio.write(ROWS[:1], SCHEMA, out, mode="overwrite")
         assert os.path.exists(os.path.join(other, "inflight.tmp"))
         assert len(tfio.read(out, schema=SCHEMA)) == 1  # old data cleared
+
+
+class TestUncoveredReadPaths:
+    def test_inference_on_compressed_dataset(self, sandbox):
+        out = str(sandbox / "gzinf")
+        tfio.write(ROWS, SCHEMA, out, mode="overwrite", codec="gzip")
+        table = tfio.read(out)  # no schema: infer from .gz shards
+        assert sorted(table.column("id")) == [11, 21, 31]
+
+    def test_byte_array_with_partitions(self, sandbox):
+        schema = StructType(
+            [StructField("byteArray", BinaryType()), StructField("day", StringType())]
+        )
+        rows = [[b"p1", "a"], [b"p2", "b"]]
+        out = str(sandbox / "bap")
+        tfio.write(rows, schema, out, mode="overwrite", partition_by=["day"],
+                   recordType="ByteArray")
+        table = tfio.read(out, recordType="ByteArray")
+        got = sorted(table.to_dicts(), key=lambda d: d["byteArray"])
+        assert got == [{"byteArray": b"p1", "day": "a"}, {"byteArray": b"p2", "day": "b"}]
+
+    def test_unknown_column_select_names_available(self, sandbox):
+        out = str(sandbox / "badsel")
+        tfio.write(ROWS, SCHEMA, out, mode="overwrite")
+        with pytest.raises(ValueError, match="available"):
+            tfio.read(out, schema=SCHEMA, columns=["id", "nope"])

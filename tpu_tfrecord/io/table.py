@@ -31,8 +31,9 @@ class Table:
         return [row[idx] for row in self.rows]
 
     def select(self, names: Sequence[str]) -> "Table":
+        selected = self.schema.select(list(names))  # validates, names available
         idxs = [self.schema.field_index(n) for n in names]
-        return Table(self.schema.select(list(names)), [[r[i] for i in idxs] for r in self.rows])
+        return Table(selected, [[r[i] for i in idxs] for r in self.rows])
 
     def sort_by(self, name: str) -> "Table":
         idx = self.schema.field_index(name)

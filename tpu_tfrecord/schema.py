@@ -220,6 +220,11 @@ class StructType:
         return StructType(list(self.fields) + [StructField(name, data_type, nullable)])
 
     def select(self, names: List[str]) -> "StructType":
+        missing = [n for n in names if n not in self._index]
+        if missing:
+            raise ValueError(
+                f"unknown column(s) {missing}; available: {self.names}"
+            )
         return StructType([self[n] for n in names])
 
     def drop(self, names) -> "StructType":
