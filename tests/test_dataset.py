@@ -63,6 +63,22 @@ class TestBatching:
         assert set(b.columns) == {"score"}
 
 
+class TestIteratorLifecycle:
+    def test_next_after_close_raises_stop_iteration(self, sandbox):
+        """close() makes the producer exit without its None sentinel; a
+        subsequent __next__ must raise StopIteration, never block forever."""
+        out = write_shards(sandbox, num_shards=2, rows_per_shard=10)
+        ds = TFRecordDataset(out, batch_size=4, schema=SCHEMA)
+        it = ds.batches()
+        next(it)
+        it.close()
+        with pytest.raises(StopIteration):
+            next(it)
+        # and stays closed
+        with pytest.raises(StopIteration):
+            next(it)
+
+
 class TestShardAssignment:
     def test_processes_partition_the_data(self, sandbox):
         out = write_shards(sandbox, num_shards=4, rows_per_shard=4)

@@ -171,6 +171,44 @@ class TestPartitionBy:
             )
 
 
+class TestPartitionTypeInference:
+    """Strict numeric classification: values Python's int()/float() accept
+    but JVM parsing (the reference's substrate) rejects must stay strings."""
+
+    def test_strict_long_and_double(self):
+        from tpu_tfrecord.io.paths import infer_partition_type
+        from tpu_tfrecord.schema import DoubleType as D, LongType as L, StringType as S
+
+        assert infer_partition_type(["1", "-2", "+3"]) == L()
+        assert infer_partition_type(["1", "2.5"]) == D()
+        assert infer_partition_type(["1e3", ".5", "3.", "-1.5E-2"]) == D()
+        for v in ["1_0", " 1", "1 ", "inf", "nan", "Infinity", "NaN", "0x10", "1.0f", ""]:
+            assert infer_partition_type([v]) == S(), v
+        # one string value demotes the whole column
+        assert infer_partition_type(["1", "1_0"]) == S()
+        # None (HIVE default partition) does not affect classification
+        assert infer_partition_type([None, "4"]) == L()
+
+
+class TestStrictOptions:
+    def test_unknown_option_raises_with_did_you_mean(self):
+        from tpu_tfrecord.options import TFRecordOptions
+
+        with pytest.raises(ValueError, match="verifyCrc"):
+            TFRecordOptions.from_map({"verifyCRC": "true"})
+        with pytest.raises(ValueError, match="codec"):
+            TFRecordOptions.from_map({"codec_": "gzip"})
+        with pytest.raises(ValueError, match="Unknown option"):
+            TFRecordOptions.from_map({"utterly_bogus_key": 1})
+
+    def test_unknown_option_raises_through_read_api(self, sandbox):
+        schema = StructType([StructField("x", LongType())])
+        out = str(sandbox / "strict")
+        tfio.write([[1]], schema, out, mode="overwrite")
+        with pytest.raises(ValueError, match="recordType"):
+            tfio.read(out, recordtype="Example")  # typo'd case
+
+
 class TestSequenceExampleRoundTrip:
     """TFRecordIOSuite.scala:153-167."""
 

@@ -260,6 +260,112 @@ class TestReviewRegressions:
         assert want["x"].values[0] == 9  # protobuf map: last wins
         assert got["x"].values[0] == 9
 
+    def test_duplicate_featurelist_key_last_wins_both_paths(self):
+        # hand-build a SequenceExample whose feature_lists map has "x" twice
+        # (proto.py's dict-based builder can't emit duplicate keys)
+        def int64_feature(v):
+            il = bytes([0x0A, 0x01, v])  # Int64List field1 packed, one value
+            return bytes([0x1A, len(il)]) + il
+
+        def fl_entry(vals):
+            feats = b"".join(
+                bytes([0x0A, len(int64_feature(v))]) + int64_feature(v)
+                for v in vals
+            )
+            e = bytes([0x0A, 1, ord("x"), 0x12, len(feats)]) + feats
+            return bytes([0x0A, len(e)]) + e
+
+        payload = fl_entry([5, 6]) + fl_entry([9])
+        record = bytes([0x12, len(payload)]) + payload  # SequenceExample.feature_lists
+        schema = StructType([StructField("x", ArrayType(LongType()))])
+        want = ColumnarDecoder(schema, RecordType.SEQUENCE_EXAMPLE).decode_batch([record])
+        got = _native.NativeDecoder(schema, RecordType.SEQUENCE_EXAMPLE).decode_batch([record])
+        # protobuf map semantics: the LAST occurrence wins on both paths
+        np.testing.assert_array_equal(want["x"].values, [9])
+        np.testing.assert_array_equal(got["x"].values, want["x"].values)
+        np.testing.assert_array_equal(got["x"].offsets, want["x"].offsets)
+
+    def test_duplicate_featurelist_key_last_wins_ragged2(self):
+        # same, for a 2-D column: each inner Feature carries multiple values
+        def int64_feature(vals):
+            il = bytes([0x0A, len(vals)] + list(vals))
+            return bytes([0x1A, len(il)]) + il
+
+        def fl_entry(frames):
+            feats = b"".join(
+                bytes([0x0A, len(int64_feature(f))]) + int64_feature(f)
+                for f in frames
+            )
+            e = bytes([0x0A, 1, ord("m"), 0x12, len(feats)]) + feats
+            return bytes([0x0A, len(e)]) + e
+
+        payload = fl_entry([[1, 2], [3]]) + fl_entry([[7]])
+        record = bytes([0x12, len(payload)]) + payload
+        schema = StructType([StructField("m", ArrayType(ArrayType(LongType())))])
+        want = ColumnarDecoder(schema, RecordType.SEQUENCE_EXAMPLE).decode_batch([record])
+        got = _native.NativeDecoder(schema, RecordType.SEQUENCE_EXAMPLE).decode_batch([record])
+        np.testing.assert_array_equal(want["m"].values, [7])
+        np.testing.assert_array_equal(got["m"].values, want["m"].values)
+        np.testing.assert_array_equal(got["m"].offsets, want["m"].offsets)
+        np.testing.assert_array_equal(got["m"].inner_offsets, want["m"].inner_offsets)
+
+    def test_context_beats_feature_lists_both_wire_orders(self):
+        """Same key in context AND feature_lists: the oracle gives context
+        priority (columnar.py:340-346) regardless of the order the two maps
+        appear in the wire — native must agree (a FL-duplicate rollback must
+        never evict a context value)."""
+        def int64_feature(vals):
+            il = bytes([0x0A, len(vals)] + list(vals))
+            return bytes([0x1A, len(il)]) + il
+
+        # context { x: [1, 2] }  (Features map entry, SequenceExample field 1)
+        feat = int64_feature([1, 2])
+        ctx_entry = bytes([0x0A, 1, ord("x"), 0x12, len(feat)]) + feat
+        ctx_payload = bytes([0x0A, len(ctx_entry)]) + ctx_entry
+        context = bytes([0x0A, len(ctx_payload)]) + ctx_payload
+        # feature_lists { x: [[9]] }  (field 2)
+        inner = int64_feature([9])
+        fl = bytes([0x0A, len(inner)]) + inner
+        fl_entry = bytes([0x0A, 1, ord("x"), 0x12, len(fl)]) + fl
+        fl_payload = bytes([0x0A, len(fl_entry)]) + fl_entry
+        flists = bytes([0x12, len(fl_payload)]) + fl_payload
+
+        schema = StructType([StructField("x", ArrayType(LongType()))])
+        for record in (context + flists, flists + context):
+            want = ColumnarDecoder(schema, RecordType.SEQUENCE_EXAMPLE).decode_batch([record])
+            got = _native.NativeDecoder(schema, RecordType.SEQUENCE_EXAMPLE).decode_batch([record])
+            np.testing.assert_array_equal(want["x"].values, [1, 2])
+            np.testing.assert_array_equal(got["x"].values, want["x"].values)
+            np.testing.assert_array_equal(got["x"].offsets, want["x"].offsets)
+
+    def test_decode_first_native_call_hashes_correctly(self):
+        """tfr_decode_batch must init the CRC table itself: in a process
+        whose FIRST native call is a fused-hash decode, bucket indices must
+        match the Python oracle (on non-SSE4.2 builds a zeroed software
+        table would silently skew them)."""
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        code = (
+            "import sys; sys.path.insert(0, %r)\n"
+            "from tpu_tfrecord import _native\n"
+            "from tpu_tfrecord.proto import Example, Feature, encode_example\n"
+            "from tpu_tfrecord.schema import StringType, StructField, StructType\n"
+            "schema = StructType([StructField('c', StringType())])\n"
+            "rec = encode_example(Example(features={'c': Feature.bytes_list([b'hello'])}))\n"
+            "dec = _native.NativeDecoder(schema, hash_buckets={'c': 1000})\n"
+            "cb = dec.decode_batch([rec])\n"
+            "print('BUCKET', int(cb['c'].values[0]))\n" % repo
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True, timeout=120
+        )
+        assert out.returncode == 0, out.stderr
+        want = wire.crc32c_py(b"hello") % 1000
+        assert f"BUCKET {want}" in out.stdout
+
     def test_empty_inner_numeric_feature_raises_named_error(self):
         from tpu_tfrecord.proto import FeatureList, SequenceExample, encode_sequence_example
 

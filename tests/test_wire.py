@@ -136,3 +136,78 @@ class TestCodecs:
         assert wire.codec_from_path("part-0.tfrecord.gz") == "gzip"
         assert wire.codec_from_path("part-0.tfrecord.deflate") == "deflate"
         assert wire.codec_from_path("part-0.tfrecord") is None
+
+
+class TestDeflateStreaming:
+    """_DeflateFile reads must stream through zlib.decompressobj, not
+    materialize the whole shard on open (the slab-streaming bounded-memory
+    contract, io/dataset.py _shard_slabs)."""
+
+    def _write_incompressible(self, path, nbytes):
+        rng = __import__("numpy").random.default_rng(7)
+        data = rng.integers(0, 256, size=nbytes, dtype="uint8").tobytes()
+        with wire.open_compressed(path, "wb", "deflate") as fh:
+            fh.write(data)
+        return data
+
+    def test_small_read_does_not_consume_whole_file(self, sandbox):
+        import os
+
+        path = str(sandbox / "big.deflate")
+        data = self._write_incompressible(path, 5 << 20)  # ~5 MB compressed
+        fh = wire._DeflateFile(path, "rb")
+        try:
+            head = fh.read(4096)
+            assert head == data[:4096]
+            # only ~one compressed chunk should have been read from disk
+            assert fh._fh.tell() <= wire._DeflateFile._READ_CHUNK + 4096
+            assert fh._fh.tell() < os.path.getsize(path) // 2
+        finally:
+            fh.close()
+
+    def test_incremental_reads_round_trip(self, sandbox):
+        path = str(sandbox / "inc.deflate")
+        data = self._write_incompressible(path, 3 << 20)
+        fh = wire._DeflateFile(path, "rb")
+        try:
+            # odd-sized reads walk the unconsumed_tail path repeatedly
+            chunks, n = [], 0
+            while True:
+                c = fh.read(70_001)
+                if not c:
+                    break
+                chunks.append(c)
+                n += len(c)
+            assert b"".join(chunks) == data and n == len(data)
+        finally:
+            fh.close()
+
+    def test_read_all_after_partial(self, sandbox):
+        path = str(sandbox / "all.deflate")
+        data = self._write_incompressible(path, 1 << 20)
+        fh = wire._DeflateFile(path, "rb")
+        try:
+            head = fh.read(10)
+            rest = fh.read(-1)
+            assert head + rest == data
+        finally:
+            fh.close()
+
+    def test_truncated_stream_raises(self, sandbox):
+        """A .deflate file cut mid-stream must raise, not silently return a
+        prefix (whole-file zlib.decompress raised Error -5 here)."""
+        import os
+
+        path = str(sandbox / "trunc.deflate")
+        self._write_incompressible(path, 1 << 20)
+        with open(path, "rb") as fh:
+            blob = fh.read()
+        with open(path, "wb") as fh:
+            fh.write(blob[: len(blob) // 2])
+        fh = wire._DeflateFile(path, "rb")
+        try:
+            with pytest.raises(wire.TFRecordCorruptionError, match="truncated deflate"):
+                while fh.read(1 << 16):
+                    pass
+        finally:
+            fh.close()

@@ -415,9 +415,15 @@ int64_t parse_feature_values(const uint8_t* fp, const uint8_t* fend,
 }
 
 // Decode one Features map region (Example.features or SequenceExample.context)
+// seen_epoch: record index for which a column holds a value (any source).
+// seen_fl_epoch: record index for which that value came from feature_lists —
+// needed to arbitrate precedence: context beats feature_lists regardless of
+// wire order (the oracle parses into dicts first, columnar.py:340-346), while
+// duplicate keys WITHIN one map are protobuf-map last-wins.
 bool parse_features_map(const uint8_t* p, const uint8_t* end, const FieldMap& fields,
                         StickyOrder& sticky,
                         std::vector<ColBuilder>& cols, std::vector<int32_t>& seen_epoch,
+                        std::vector<int32_t>& seen_fl_epoch,
                         int32_t epoch, std::string& err) {
   Cursor c{p, end};
   while (c.p < c.end) {
@@ -459,11 +465,13 @@ bool parse_features_map(const uint8_t* p, const uint8_t* end, const FieldMap& fi
       return false;
     }
     if (seen_epoch[idx] == epoch) {
-      // Duplicate map key in one record: protobuf map semantics are
-      // last-wins (matching the Python path) — roll back the previous
-      // occurrence's contribution, then re-append.
+      // Already set this record: either a duplicate context key (protobuf
+      // map last-wins) or a feature_lists entry that appeared earlier in
+      // the wire (context has priority either way) — roll back the previous
+      // contribution, then re-append.
       col.rollback();
       seen_epoch[idx] = -1;  // unseen again until the re-append succeeds
+      seen_fl_epoch[idx] = -1;  // any feature_lists claim is gone
     }
     col.cur_row = epoch;  // record index, for group-matrix writes
     bool scalar = col.layout == LAYOUT_SCALAR;
@@ -500,6 +508,7 @@ bool parse_features_map(const uint8_t* p, const uint8_t* end, const FieldMap& fi
 bool parse_feature_lists(const uint8_t* p, const uint8_t* end, const FieldMap& fields,
                          StickyOrder& sticky,
                          std::vector<ColBuilder>& cols, std::vector<int32_t>& seen_epoch,
+                         std::vector<int32_t>& seen_fl_epoch,
                          int32_t epoch, std::string& err) {
   Cursor c{p, end};
   while (c.p < c.end) {
@@ -535,7 +544,20 @@ bool parse_feature_lists(const uint8_t* p, const uint8_t* end, const FieldMap& f
     int idx = sticky.lookup(key, fields);
     if (idx < 0) continue;
     ColBuilder& col = cols[idx];
-    if (seen_epoch[idx] == epoch) continue;
+    if (seen_epoch[idx] == epoch && seen_fl_epoch[idx] != epoch) {
+      // Set by the context map: context wins over feature_lists
+      // (oracle parity, columnar.py:340-346) — skip this entry entirely.
+      continue;
+    }
+    if (seen_fl_epoch[idx] == epoch) {
+      // Duplicate FeatureList map key in one record: protobuf map semantics
+      // are last-wins (matching the Python oracle's dict overwrite) — roll
+      // back the previous occurrence's contribution, then re-append, the
+      // same contract as the context/features path above.
+      col.rollback();
+      seen_epoch[idx] = -1;  // unseen again until the re-append succeeds
+      seen_fl_epoch[idx] = -1;
+    }
     // iterate FeatureList { repeated Feature feature = 1; }
     int64_t n_inner = 0;
     Cursor lc{lstart ? lstart : end, lend ? lend : end};
@@ -567,6 +589,7 @@ bool parse_feature_lists(const uint8_t* p, const uint8_t* end, const FieldMap& f
       }
     }
     seen_epoch[idx] = epoch;
+    seen_fl_epoch[idx] = epoch;
     if (col.layout == LAYOUT_RAGGED2) {
       col.value_count += n_inner;       // rows index inner lists
       col.row_offsets.push_back(col.value_count);
@@ -670,6 +693,10 @@ void* tfr_decode_batch(const uint8_t* buf,
                        const int32_t* group_ids, const int64_t* group_offs,
                        int32_t n_groups, const int64_t* group_strides,
                        char* errbuf, int64_t errbuf_len) {
+  // The fused categorical-hash path uses crc32c; without this, a process
+  // whose FIRST native call is decode would hash through a zeroed software
+  // CRC table on non-SSE4.2 builds (silent wrong bucket indices).
+  init_crc32c_table();
   auto* res = new BatchResult();
   res->cols.resize(n_fields);
   res->group_bufs.resize(n_groups);
@@ -710,6 +737,7 @@ void* tfr_decode_batch(const uint8_t* buf,
     }
   }
   std::vector<int32_t> seen_epoch(n_fields, -1);
+  std::vector<int32_t> seen_fl_epoch(n_fields, -1);
   StickyOrder sticky_features, sticky_lists;
   std::string err;
 
@@ -729,9 +757,9 @@ void* tfr_decode_batch(const uint8_t* buf,
         const uint8_t* me = c.p + mlen;
         c.p += mlen;
         if (record_format == 1 && fnum == 2) {
-          ok = parse_feature_lists(ms, me, fields, sticky_lists, res->cols, seen_epoch, (int32_t)r, err);
+          ok = parse_feature_lists(ms, me, fields, sticky_lists, res->cols, seen_epoch, seen_fl_epoch, (int32_t)r, err);
         } else {
-          ok = parse_features_map(ms, me, fields, sticky_features, res->cols, seen_epoch, (int32_t)r, err);
+          ok = parse_features_map(ms, me, fields, sticky_features, res->cols, seen_epoch, seen_fl_epoch, (int32_t)r, err);
         }
       } else {
         if (!skip_field(c, wt)) { err = "bad record field"; ok = false; }

@@ -504,7 +504,18 @@ class CheckpointableIterator:
     def __next__(self) -> ColumnarBatch:
         if self._finished is not None:
             raise self._finished if not isinstance(self._finished, bool) else StopIteration
-        item = self._queue.get()
+        while True:
+            if self._stop.is_set():
+                # close()d: iteration is over — the producer exits without
+                # enqueuing its None sentinel, so never block forever (and a
+                # batch racing into the queue during close() is not yielded).
+                self._finished = True
+                raise StopIteration
+            try:
+                item = self._queue.get(timeout=0.1)
+                break
+            except queue.Empty:
+                continue
         if item is None:
             self._finished = True
             self._stop.set()  # let any lingering pipeline threads exit

@@ -79,20 +79,24 @@ def parse_partition_component(component: str) -> Optional[Tuple[str, Optional[st
     return unescape_partition_value(col), unescape_partition_value(raw)
 
 
+# Strict numeric shapes for partition-value classification. Python's
+# int()/float() are more permissive than the JVM parsing the reference rides
+# on (underscore separators '1_0', surrounding whitespace, 'inf'/'nan') —
+# those must classify as strings, or mixed datasets silently coerce.
+_PARTITION_LONG_RE = re.compile(r"[+-]?\d+\Z")
+_PARTITION_DOUBLE_RE = re.compile(r"[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?\Z")
+
+
 def infer_partition_type(values: Iterable[Optional[str]]) -> DataType:
     """Spark-style partition column type inference: long -> double -> string."""
     saw_long, saw_double = True, True
     for v in values:
         if v is None:
             continue
-        try:
-            int(v)
+        if _PARTITION_LONG_RE.match(v):
             continue
-        except ValueError:
-            saw_long = False
-        try:
-            float(v)
-        except ValueError:
+        saw_long = False
+        if not _PARTITION_DOUBLE_RE.match(v):
             saw_double = False
             break
     if saw_long:

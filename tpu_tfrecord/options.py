@@ -12,7 +12,7 @@ role of the reference's SerializableConfiguration (DefaultSource.scala:145-182)
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, replace
 from typing import Any, Dict, Mapping, Optional
 
 from tpu_tfrecord import wire
@@ -61,12 +61,25 @@ class TFRecordOptions:
     schema: Optional[StructType] = None
     verify_crc: bool = True
     infer_sample_limit: Optional[int] = None
-    extra: Dict[str, Any] = field(default_factory=dict, compare=False)
+
+    _KNOWN_KEYS = (
+        "recordType",
+        "record_type",
+        "codec",
+        "schema",
+        "verify_crc",
+        "verifyCrc",
+        "infer_sample_limit",
+        "inferSampleLimit",
+    )
 
     @staticmethod
     def from_map(options: Optional[Mapping[str, Any]] = None, **kwargs: Any) -> "TFRecordOptions":
         """Build from a string-keyed map, accepting the reference's spellings
-        (``recordType``, ``codec``) as well as snake_case."""
+        (``recordType``, ``codec``) as well as snake_case. Unknown keys raise:
+        a config typo (``codec_=``, ``verifyCRC``) must fail loudly, never
+        silently change behavior — the same principle the decoder options
+        already enforce (io/dataset.py)."""
         merged: Dict[str, Any] = dict(options or {})
         merged.update(kwargs)
         record_type = RecordType.parse(
@@ -82,13 +95,27 @@ class TFRecordOptions:
             limit = int(limit)
             if limit <= 0:
                 raise ValueError("infer_sample_limit must be positive")
+        if merged:
+            import difflib
+
+            hints = []
+            for key in merged:
+                close = difflib.get_close_matches(
+                    str(key), TFRecordOptions._KNOWN_KEYS, n=1
+                )
+                hints.append(
+                    f"{key!r}" + (f" (did you mean {close[0]!r}?)" if close else "")
+                )
+            raise ValueError(
+                f"Unknown option(s): {', '.join(hints)}. Supported options: "
+                + ", ".join(TFRecordOptions._KNOWN_KEYS)
+            )
         return TFRecordOptions(
             record_type=record_type,
             codec=codec,
             schema=schema,
             verify_crc=verify_crc,
             infer_sample_limit=limit,
-            extra=merged,
         )
 
     def with_schema(self, schema: StructType) -> "TFRecordOptions":

@@ -175,32 +175,74 @@ def open_compressed(path: str, mode: str, codec: Optional[str]) -> BinaryIO:
 
 
 class _DeflateFile(io.RawIOBase):
-    """zlib-wrapped file (Hadoop DefaultCodec writes raw zlib streams)."""
+    """zlib-wrapped file (Hadoop DefaultCodec writes raw zlib streams).
+
+    Reads stream through ``zlib.decompressobj`` with bounded output per
+    step (mirroring how gzip.open streams), so a large ``.deflate`` shard
+    honors the slab-streaming bounded-memory contract (io/dataset.py
+    ``_shard_slabs``) instead of materializing whole on open.
+    """
+
+    _READ_CHUNK = 1 << 20  # compressed bytes per underlying read
 
     def __init__(self, path: str, mode: str):
         super().__init__()
         self._mode = mode
+        self._path = path
         if "w" in mode:
             self._fh = open(path, "wb")
             self._compress = zlib.compressobj()
-            self._buffer = None
+            self._decompress = None
         else:
-            with open(path, "rb") as fh:
-                self._buffer = io.BytesIO(zlib.decompress(fh.read()))
-            self._fh = None
+            self._fh = open(path, "rb")
             self._compress = None
+            self._decompress = zlib.decompressobj()
+            self._pending = bytearray()
+            self._eof = False
 
     def readable(self) -> bool:
-        return self._buffer is not None
+        return self._decompress is not None
 
     def writable(self) -> bool:
         return self._compress is not None
 
+    def _fill(self, want: int) -> None:
+        """Decompress until ``want`` more bytes are pending or EOF; output
+        per step is capped at ``want`` so memory stays ~pending+want."""
+        d = self._decompress
+        if d.unconsumed_tail:
+            self._pending += d.decompress(d.unconsumed_tail, want)
+            return
+        raw = self._fh.read(self._READ_CHUNK)
+        if not raw:
+            tail = d.flush()
+            if not d.eof:
+                # file ended mid-stream (partial copy/upload): whole-file
+                # zlib.decompress raised here; streaming must too, or
+                # trailing rows vanish silently
+                raise TFRecordCorruptionError(
+                    f"truncated deflate stream in {self._path}"
+                )
+            self._pending += tail
+            self._eof = True
+            return
+        self._pending += d.decompress(raw, want)
+
     def read(self, size: int = -1) -> bytes:
-        return self._buffer.read(size)
+        if size is None or size < 0:
+            while not self._eof:
+                self._fill(self._READ_CHUNK)
+            out = bytes(self._pending)
+            self._pending = bytearray()
+            return out
+        while len(self._pending) < size and not self._eof:
+            self._fill(size - len(self._pending))
+        out = bytes(self._pending[:size])
+        del self._pending[:size]
+        return out
 
     def readinto(self, b) -> int:
-        data = self._buffer.read(len(b))
+        data = self.read(len(b))
         b[: len(data)] = data
         return len(data)
 
@@ -212,6 +254,7 @@ class _DeflateFile(io.RawIOBase):
         if not self.closed:
             if self._compress is not None:
                 self._fh.write(self._compress.flush())
+            if self._fh is not None:
                 self._fh.close()
             super().close()
 
