@@ -182,7 +182,11 @@ class TestPartitionTypeInference:
         assert infer_partition_type(["1", "-2", "+3"]) == L()
         assert infer_partition_type(["1", "2.5"]) == D()
         assert infer_partition_type(["1e3", ".5", "3.", "-1.5E-2"]) == D()
-        for v in ["1_0", " 1", "1 ", "inf", "nan", "Infinity", "NaN", "0x10", "1.0f", ""]:
+        # Java Long.parseLong does not trim; Double.parseDouble does and
+        # accepts exact-case NaN/Infinity
+        assert infer_partition_type([" 1", "1 ", " 1.5 "]) == D()
+        assert infer_partition_type(["NaN", "Infinity", "-Infinity", "2.5"]) == D()
+        for v in ["1_0", "inf", "nan", "infinity", "0x10", "1.0f", "", " "]:
             assert infer_partition_type([v]) == S(), v
         # one string value demotes the whole column
         assert infer_partition_type(["1", "1_0"]) == S()

@@ -92,6 +92,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         i32p, i64p, ctypes.c_int32, i64p,
         ctypes.c_char_p, ctypes.c_int64,
     ]
+    lib.tfr_scan_decode.restype = ctypes.c_void_p
+    lib.tfr_scan_decode.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_int32, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_int32, ctypes.POINTER(ctypes.c_char_p),
+        i32p, i32p, i32p, u8p, i64p,
+        i32p, i64p, ctypes.c_int32, i64p,
+        i64p, i64p, u64p,
+        ctypes.c_char_p, ctypes.c_int64,
+    ]
     lib.tfr_result_group.restype = ctypes.c_int64
     lib.tfr_result_group.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(u8p)]
     for name in ("tfr_result_values",):
@@ -428,6 +438,66 @@ class NativeDecoder:
             return self._extract(handle, n_records)
         finally:
             lib.tfr_result_free(handle)
+
+    def scan_decode(
+        self,
+        buf: bytes,
+        start: int,
+        verify_crc: bool,
+        skip_records: int,
+        max_records: int,
+    ) -> Tuple[Optional[ColumnarBatch], int, int, int]:
+        """Fused frame scan + decode in ONE pass over ``buf`` from ``start``:
+        CRC-verify and skip ``skip_records`` frames (resume), then decode up
+        to ``max_records`` records — each parsed right after its CRC while
+        its bytes are cache-hot; no offsets/lengths arrays materialize.
+        Returns (batch_or_None, n_skipped, n_decoded, consumed_abs); stops
+        without error at a partial tail frame."""
+        from tpu_tfrecord.wire import TFRecordCorruptionError
+
+        lib = self._lib
+        errbuf = ctypes.create_string_buffer(512)
+        n_sk = ctypes.c_int64(0)
+        n_de = ctypes.c_int64(0)
+        consumed = ctypes.c_uint64(start)
+        handle = lib.tfr_scan_decode(
+            buf,
+            len(buf),
+            start,
+            1 if verify_crc else 0,
+            skip_records,
+            max_records,
+            self._fmt,
+            len(self.schema),
+            self._c_names,
+            self._layouts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            self._kinds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            self._dtypes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            self._nullables.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            self._hash.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            self._group_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            self._group_offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(self._group_meta),
+            self._group_strides.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.byref(n_sk),
+            ctypes.byref(n_de),
+            ctypes.byref(consumed),
+            errbuf,
+            len(errbuf),
+        )
+        if not handle:
+            msg = errbuf.value.decode("utf-8", "replace")
+            if msg.startswith("corrupt TFRecord"):
+                raise TFRecordCorruptionError(msg)
+            if "does not allow null values" in msg:
+                raise NullValueError(msg)
+            raise ValueError(f"native decode failed: {msg}")
+        n_decoded = int(n_de.value)
+        try:
+            cb = self._extract(handle, n_decoded) if n_decoded else None
+        finally:
+            lib.tfr_result_free(handle)
+        return cb, int(n_sk.value), n_decoded, int(consumed.value)
 
     def decode_batch(self, records) -> ColumnarBatch:
         """List-of-bytes interface (drop-in for ColumnarDecoder): records are

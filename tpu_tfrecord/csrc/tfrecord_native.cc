@@ -71,9 +71,65 @@ uint32_t crc32c_sw(const uint8_t* p, uint64_t n, uint32_t crc) {
   return crc ^ 0xFFFFFFFFu;
 }
 
+#if defined(__SSE4_2__)
+// Advance-by-256-zero-bytes tables: shift256(c) == the CRC state after
+// feeding 256 zero bytes starting from state c. The state update is linear
+// over GF(2), so the transform decomposes into 4 byte-indexed tables. This
+// lets three independent _mm_crc32_u64 chains run in parallel over 3x256B
+// blocks (the serial 3-cycle latency chain is the bottleneck of the naive
+// loop) and be combined afterwards — ~2x on the ~1KB payloads TFRecord
+// shards typically carry.
+uint32_t crc_shift256_tbl[4][256];
+bool crc_shift256_init_done = false;
+
+void init_crc_shift256() {
+  if (crc_shift256_init_done) return;
+  uint32_t basis[32];
+  for (int b = 0; b < 32; b++) {
+    uint32_t c = 1u << b;
+    for (int i = 0; i < 32; i++) c = (uint32_t)_mm_crc32_u64(c, 0);  // 8 zero bytes x32
+    basis[b] = c;
+  }
+  for (int k = 0; k < 4; k++) {
+    for (int v = 0; v < 256; v++) {
+      uint32_t acc = 0;
+      for (int j = 0; j < 8; j++)
+        if (v & (1 << j)) acc ^= basis[8 * k + j];
+      crc_shift256_tbl[k][v] = acc;
+    }
+  }
+  crc_shift256_init_done = true;
+}
+
+inline uint32_t crc_shift256(uint32_t c) {
+  return crc_shift256_tbl[0][c & 0xFF] ^ crc_shift256_tbl[1][(c >> 8) & 0xFF] ^
+         crc_shift256_tbl[2][(c >> 16) & 0xFF] ^ crc_shift256_tbl[3][c >> 24];
+}
+#endif
+
 uint32_t crc32c_impl(const uint8_t* p, uint64_t n, uint32_t crc) {
 #if defined(__SSE4_2__)
   crc ^= 0xFFFFFFFFu;
+  if (n >= 768) {
+    init_crc_shift256();
+    do {
+      uint32_t c0 = crc, c1 = 0, c2 = 0;
+      const uint8_t* p1 = p + 256;
+      const uint8_t* p2 = p + 512;
+      for (int i = 0; i < 256; i += 8) {
+        uint64_t w0, w1, w2;
+        std::memcpy(&w0, p + i, 8);
+        std::memcpy(&w1, p1 + i, 8);
+        std::memcpy(&w2, p2 + i, 8);
+        c0 = (uint32_t)_mm_crc32_u64(c0, w0);
+        c1 = (uint32_t)_mm_crc32_u64(c1, w1);
+        c2 = (uint32_t)_mm_crc32_u64(c2, w2);
+      }
+      crc = crc_shift256(crc_shift256(c0) ^ c1) ^ c2;
+      p += 768;
+      n -= 768;
+    } while (n >= 768);
+  }
   while (n >= 8) {
     uint64_t w;
     std::memcpy(&w, p, 8);
@@ -602,6 +658,230 @@ bool parse_feature_lists(const uint8_t* p, const uint8_t* end, const FieldMap& f
   return true;
 }
 
+// ---------------------------------------------------------------------------
+// Turbo path: sticky-prefix specialized record parse
+// ---------------------------------------------------------------------------
+//
+// Records from one serializer share their byte-level key structure: every
+// record's features map has the same entries in the same order, differing
+// only in the value payloads. After the first record builds the sticky
+// order, each subsequent record is matched entry-by-entry against the
+// precomputed prefix bytes [0x0A klen key] with one memcmp, skipping the
+// generic tag-dispatch walk entirely (which costs ~half of decode time on
+// wide schemas). ANY deviation — missing/extra/duplicate keys, unexpected
+// wire layout, empty or multi-segment features — rolls back the partial
+// record and re-parses it with the generic (oracle-verified) path, so turbo
+// is purely an optimization: byte-identical results by construction.
+// Applies to Example records whose schema is all-scalar (the common dense
+// tabular case, e.g. Criteo).
+
+struct TurboSlot {
+  std::vector<uint8_t> prefix;  // 0x0A klen <key bytes>
+  int idx;                      // field index, or -1 (pruned: skip entry)
+  // Adaptive full-entry cache: records from one serializer usually repeat
+  // the exact entry byte shape (all tags + lengths), differing only in the
+  // value payload. When the cached shape matches (ONE memcmp), the value
+  // sits at a fixed offset — no per-field tag walking at all. A miss falls
+  // back to the field-wise parse below, which refreshes the cache.
+  std::vector<uint8_t> cache;   // entry bytes from entry tag to value start
+  uint32_t entry_total = 0;     // full entry byte length (tag..end)
+  uint32_t value_len = 0;       // value payload bytes (BYTES/FLOAT: fixed)
+};
+
+inline bool turbo_read_varint(const uint8_t*& p, const uint8_t* end, uint64_t* out) {
+  if (p < end && !(*p & 0x80)) { *out = *p++; return true; }  // 1-byte fast case
+  uint64_t result = 0;
+  int shift = 0;
+  while (p < end) {
+    uint8_t b = *p++;
+    result |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) { *out = result; return true; }
+    shift += 7;
+    if (shift > 63) return false;
+  }
+  return false;
+}
+
+// Parse one record in turbo mode. Returns true on success (columns written,
+// caller sets seen_epoch); false = no harm done (partial writes rolled
+// back), caller re-parses generically. Slots are mutable: their adaptive
+// entry caches refresh as value shapes drift.
+bool turbo_parse(const uint8_t* rp, const uint8_t* rend,
+                 std::vector<TurboSlot>& slots,
+                 std::vector<ColBuilder>& cols, int32_t epoch) {
+  const uint8_t* p = rp;
+  // Record must be exactly one top-level field: features map (tag 0x0A).
+  if (p >= rend || *p != 0x0A) return false;
+  p++;
+  uint64_t mlen;
+  if (!turbo_read_varint(p, rend, &mlen)) return false;
+  if ((uint64_t)(rend - p) != mlen) return false;
+  int written[256];
+  int n_written = 0;
+  auto abort_record = [&]() {
+    for (int i = 0; i < n_written; i++) cols[written[i]].rollback();
+    return false;
+  };
+  for (TurboSlot& s : slots) {
+    // --- cache-hit fast lane: one memcmp covers every tag and length ---
+    if (s.entry_total && (uint64_t)(rend - p) >= s.entry_total &&
+        std::memcmp(p, s.cache.data(), s.cache.size()) == 0) {
+      const uint8_t* q = p + s.cache.size();
+      p += s.entry_total;
+      if (s.idx < 0) continue;
+      if (n_written >= 256) return abort_record();
+      ColBuilder& col = cols[s.idx];
+      col.cur_row = epoch;
+      if (col.kind == KIND_INT64) {
+        // value: one-varint-or-more packed run of s.value_len bytes
+        const uint8_t* ve = q + s.value_len;
+        uint64_t v;
+        if (!turbo_read_varint(q, ve, &v)) return abort_record();
+        while (q < ve) {  // rest of the run: validate well-formed varints
+          int cont = 0;
+          while (q < ve && (*q & 0x80)) { q++; cont++; }
+          if (q >= ve || cont > 9) return abort_record();
+          q++;
+        }
+        col.push_i64((int64_t)v);
+      } else if (col.kind == KIND_BYTES) {
+        if (col.hash_buckets > 0) {
+          uint32_t h = crc32c_impl(q, s.value_len, 0);
+          col.push_hashed((int32_t)(h % (uint64_t)col.hash_buckets));
+        } else {
+          col.push_bytes(q, s.value_len);
+        }
+      } else {  // KIND_FLOAT
+        float v;
+        std::memcpy(&v, q, 4);
+        col.push_f32(v);
+      }
+      col.mask.push_back(1);
+      written[n_written++] = s.idx;
+      continue;
+    }
+    // --- field-wise lane (cache miss): parse tags, refresh the cache ---
+    const uint8_t* p0 = p;  // entry tag byte (cache starts here)
+    if (p >= rend || *p != 0x0A) return abort_record();
+    p++;
+    uint64_t elen;
+    if (!turbo_read_varint(p, rend, &elen)) return abort_record();
+    const uint8_t* ee = p + elen;
+    if (ee > rend || elen < s.prefix.size() ||
+        std::memcmp(p, s.prefix.data(), s.prefix.size()) != 0)
+      return abort_record();
+    const uint8_t* q = p + s.prefix.size();
+    p = ee;
+    if (s.idx < 0) {
+      // pruned column: cache the key prefix so future skips are one memcmp
+      if (ee - p0 < 0x10000) {
+        s.cache.assign(p0, p0 + (q - p0));
+        s.entry_total = (uint32_t)(ee - p0);
+        s.value_len = 0;
+      }
+      continue;
+    }
+    if (n_written >= 256) return abort_record();  // absurd width: stay correct
+    ColBuilder& col = cols[s.idx];
+    // map-entry value: Feature (field 2) filling the rest of the entry
+    if (q >= ee || *q != 0x12) return abort_record();
+    q++;
+    uint64_t flen;
+    if (!turbo_read_varint(q, ee, &flen)) return abort_record();
+    if ((uint64_t)(ee - q) != flen || flen == 0) return abort_record();
+    col.cur_row = epoch;
+    const uint8_t* vstart = nullptr;
+    uint32_t vlen = 0;
+    if (col.kind == KIND_INT64) {
+      // Feature { int64_list = 3 { packed values = 1 } }
+      if (*q != 0x1A) return abort_record();
+      q++;
+      uint64_t llen;
+      if (!turbo_read_varint(q, ee, &llen)) return abort_record();
+      if ((uint64_t)(ee - q) != llen || llen == 0) return abort_record();
+      if (*q != 0x0A) return abort_record();
+      q++;
+      uint64_t plen;
+      if (!turbo_read_varint(q, ee, &plen)) return abort_record();
+      if ((uint64_t)(ee - q) != plen || plen == 0) return abort_record();
+      vstart = q;
+      vlen = (uint32_t)plen;
+      uint64_t v;
+      if (!turbo_read_varint(q, ee, &v)) return abort_record();
+      // scalar head semantics: first value wins; the rest of the packed
+      // run is legal but must still be well-formed varints (the generic
+      // path validates them, so turbo must too)
+      while (q < ee) {
+        int cont = 0;
+        while (q < ee && (*q & 0x80)) { q++; cont++; }
+        if (q >= ee || cont > 9) return abort_record();
+        q++;
+      }
+      col.push_i64((int64_t)v);
+    } else if (col.kind == KIND_BYTES) {
+      // Feature { bytes_list = 1 { values = 1 (len-delimited) } }
+      if (*q != 0x0A) return abort_record();
+      q++;
+      uint64_t llen;
+      if (!turbo_read_varint(q, ee, &llen)) return abort_record();
+      if ((uint64_t)(ee - q) != llen || llen == 0) return abort_record();
+      if (*q != 0x0A) return abort_record();
+      q++;
+      uint64_t blen;
+      if (!turbo_read_varint(q, ee, &blen)) return abort_record();
+      if ((uint64_t)(ee - q) < blen) return abort_record();
+      // single-value scalar only: a second value changes head semantics
+      // bookkeeping, so multi-value records take the generic path
+      if ((uint64_t)(ee - q) != blen) return abort_record();
+      vstart = q;
+      vlen = (uint32_t)blen;
+      if (col.hash_buckets > 0) {
+        uint32_t h = crc32c_impl(q, blen, 0);
+        col.push_hashed((int32_t)(h % (uint64_t)col.hash_buckets));
+      } else {
+        col.push_bytes(q, blen);
+      }
+    } else {  // KIND_FLOAT
+      // Feature { float_list = 2 { packed values = 1 | single = 5 } }
+      if (*q != 0x12) return abort_record();
+      q++;
+      uint64_t llen;
+      if (!turbo_read_varint(q, ee, &llen)) return abort_record();
+      if ((uint64_t)(ee - q) != llen || llen == 0) return abort_record();
+      float v;
+      if (*q == 0x0A) {
+        q++;
+        uint64_t plen;
+        if (!turbo_read_varint(q, ee, &plen)) return abort_record();
+        if ((uint64_t)(ee - q) != plen || plen < 4 || (plen & 3)) return abort_record();
+        std::memcpy(&v, q, 4);  // head semantics: first of the packed run
+        if (plen == 4) { vstart = q; vlen = 4; }
+      } else if (*q == 0x0D) {
+        q++;
+        if ((uint64_t)(ee - q) != 4) return abort_record();
+        std::memcpy(&v, q, 4);
+        vstart = q;
+        vlen = 4;
+      } else {
+        return abort_record();
+      }
+      col.push_f32(v);
+    }
+    // refresh the adaptive cache: entry header bytes up to the value
+    // payload; value fills the rest of the entry exactly (verified above)
+    if (vstart && (uint64_t)(vstart - p0) + vlen == (uint64_t)(ee - p0) &&
+        ee - p0 < 0x10000) {
+      s.cache.assign(p0, vstart);
+      s.entry_total = (uint32_t)(ee - p0);
+      s.value_len = vlen;
+    }
+    col.mask.push_back(1);
+    written[n_written++] = s.idx;
+  }
+  if (p != rend) return abort_record();  // extra entries -> generic
+  return true;
+}
+
 void append_missing(ColBuilder& col) {
   col.mask.push_back(0);
   if (col.group_buf) return;  // group matrix is zero-initialized
@@ -680,6 +960,178 @@ int64_t tfr_scan_partial(const uint8_t* buf, uint64_t len, int32_t verify,
   return n;
 }
 
+}  // extern "C" (temporarily closed: decode state helpers below are C++)
+
+namespace {
+
+// Shared state for batch decoding — used by both the span-driven
+// tfr_decode_batch and the fused tfr_scan_decode (frame scan + decode in
+// one pass over the buffer, record bytes decoded while still cache-hot).
+struct DecodeState {
+  BatchResult* res = nullptr;
+  FieldMap fields;
+  StickyOrder sticky_features, sticky_lists;
+  std::vector<int32_t> seen_epoch, seen_fl_epoch;
+  std::vector<TurboSlot> turbo_slots;
+  bool turbo_eligible = false, turbo_ready = false;
+  int32_t record_format = 0;
+  int32_t n_fields = 0;
+  std::string err;
+};
+
+// Allocate the result + columns. n_records_hint sizes the group matrices
+// and reservations; the fused path shrinks group buffers afterwards.
+void init_decode_state(DecodeState& st, int64_t n_records_hint,
+                       int32_t record_format,
+                       int32_t n_fields, const char** field_names,
+                       const int32_t* layouts, const int32_t* kinds,
+                       const int32_t* dtypes, const uint8_t* nullables,
+                       const int64_t* hash_buckets,
+                       const int32_t* group_ids, const int64_t* group_offs,
+                       int32_t n_groups, const int64_t* group_strides) {
+  st.record_format = record_format;
+  st.n_fields = n_fields;
+  auto* res = new BatchResult();
+  st.res = res;
+  res->cols.resize(n_fields);
+  res->group_bufs.resize(n_groups);
+  for (int32_t g = 0; g < n_groups; g++) {
+    res->group_bufs[g].assign((size_t)n_records_hint * group_strides[g], 0);
+  }
+  for (int32_t i = 0; i < n_fields; i++) {
+    ColBuilder& col = res->cols[i];
+    col.name = field_names[i];
+    col.layout = layouts[i];
+    col.kind = kinds[i];
+    col.dtype = dtypes[i];
+    col.nullable = nullables[i] != 0;
+    col.hash_buckets = hash_buckets ? hash_buckets[i] : 0;
+    if (group_ids && group_ids[i] >= 0) {
+      int32_t g = group_ids[i];
+      col.group_buf = res->group_bufs[g].data();
+      col.group_stride = group_strides[g];
+      col.group_off = group_offs[i];
+    }
+    col.init_offsets();
+    st.fields.emplace(col.name, i);
+    // Pre-size the common buffers for the batch.
+    col.mask.reserve(n_records_hint);
+    if (col.layout != LAYOUT_SCALAR) col.row_offsets.reserve(n_records_hint + 1);
+    if (col.group_buf) continue;  // values live in the group matrix
+    if (col.dtype == DT_BYTES) {
+      col.blob_offsets.reserve(n_records_hint + 1);
+      col.blob.reserve((size_t)n_records_hint * 8);
+    } else if (col.layout == LAYOUT_SCALAR) {
+      switch (col.dtype) {
+        case DT_I64: col.i64.reserve(n_records_hint); break;
+        case DT_I32: col.i32.reserve(n_records_hint); break;
+        case DT_F32: col.f32.reserve(n_records_hint); break;
+        case DT_F64: col.f64.reserve(n_records_hint); break;
+      }
+    }
+  }
+  st.seen_epoch.assign(n_fields, -1);
+  st.seen_fl_epoch.assign(n_fields, -1);
+  // Turbo eligibility: Example records, all-scalar schema, supported kinds
+  // (see turbo_parse). Slots are built from the sticky order after the
+  // first record parses generically.
+  st.turbo_eligible = record_format == 0 && n_fields <= 256;
+  for (int32_t i = 0; st.turbo_eligible && i < n_fields; i++) {
+    if (res->cols[i].layout != LAYOUT_SCALAR) st.turbo_eligible = false;
+  }
+}
+
+// Decode one record (r = its index in this batch). On failure fills errbuf;
+// the caller owns cleanup of st.res.
+bool decode_one(DecodeState& st, const uint8_t* rp, uint64_t rlen, int64_t r,
+                char* errbuf, int64_t errbuf_len) {
+  BatchResult* res = st.res;
+  const int32_t n_fields = st.n_fields;
+  if (r) { st.sticky_features.next_record(); st.sticky_lists.next_record(); }
+  if (st.turbo_ready &&
+      turbo_parse(rp, rp + rlen, st.turbo_slots, res->cols, (int32_t)r)) {
+    for (const TurboSlot& s : st.turbo_slots) {
+      if (s.idx >= 0) st.seen_epoch[s.idx] = (int32_t)r;
+    }
+    for (int32_t i = 0; i < n_fields; i++) {
+      if (st.seen_epoch[i] != (int32_t)r) {
+        if (!res->cols[i].nullable) {
+          std::snprintf(errbuf, errbuf_len, "record %lld: %s", (long long)r,
+                        ("Field " + res->cols[i].name +
+                         " does not allow null values").c_str());
+          return false;
+        }
+        append_missing(res->cols[i]);
+      }
+    }
+    return true;
+  }
+  Cursor c{rp, rp + rlen};
+  bool ok = true;
+  while (c.p < c.end && ok) {
+    uint64_t tag;
+    if (!read_varint(c, &tag)) { st.err = "truncated record tag"; ok = false; break; }
+    uint32_t fnum = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+    if (wt == 2 && ((st.record_format == 0 && fnum == 1) ||
+                    (st.record_format == 1 && (fnum == 1 || fnum == 2)))) {
+      uint64_t mlen;
+      if (!read_varint(c, &mlen) || (uint64_t)(c.end - c.p) < mlen) { st.err = "truncated message"; ok = false; break; }
+      const uint8_t* ms = c.p;
+      const uint8_t* me = c.p + mlen;
+      c.p += mlen;
+      if (st.record_format == 1 && fnum == 2) {
+        ok = parse_feature_lists(ms, me, st.fields, st.sticky_lists, res->cols, st.seen_epoch, st.seen_fl_epoch, (int32_t)r, st.err);
+      } else {
+        ok = parse_features_map(ms, me, st.fields, st.sticky_features, res->cols, st.seen_epoch, st.seen_fl_epoch, (int32_t)r, st.err);
+      }
+    } else {
+      if (!skip_field(c, wt)) { st.err = "bad record field"; ok = false; }
+    }
+  }
+  if (ok) {
+    for (int32_t i = 0; i < n_fields; i++) {
+      if (st.seen_epoch[i] != (int32_t)r) {
+        if (!res->cols[i].nullable) {
+          st.err = "Field " + res->cols[i].name + " does not allow null values";
+          ok = false;
+          break;
+        }
+        append_missing(res->cols[i]);
+      }
+    }
+  }
+  if (!ok) {
+    std::snprintf(errbuf, errbuf_len, "record %lld: %s", (long long)r, st.err.c_str());
+    return false;
+  }
+  if (st.turbo_eligible && !st.turbo_ready && r == 0) {
+    // Build the turbo slots from record 0's sticky order. Duplicate keys
+    // disable turbo (their last-wins bookkeeping needs the generic path).
+    st.turbo_ready = true;
+    std::vector<bool> used(n_fields, false);
+    for (auto& e : st.sticky_features.order) {
+      if (e.first.size() >= 128) { st.turbo_ready = false; break; }
+      if (e.second >= 0) {
+        if (used[e.second]) { st.turbo_ready = false; break; }
+        used[e.second] = true;
+      }
+      TurboSlot s;
+      s.prefix.reserve(2 + e.first.size());
+      s.prefix.push_back(0x0A);
+      s.prefix.push_back((uint8_t)e.first.size());
+      s.prefix.insert(s.prefix.end(), e.first.begin(), e.first.end());
+      s.idx = e.second;
+      st.turbo_slots.push_back(std::move(s));
+    }
+    if (st.turbo_slots.empty()) st.turbo_ready = false;
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
 // Batch decode. record_format: 0 = Example, 1 = SequenceExample.
 // Returns an opaque handle (free with tfr_result_free) or nullptr with
 // errbuf filled.
@@ -697,93 +1149,89 @@ void* tfr_decode_batch(const uint8_t* buf,
   // whose FIRST native call is decode would hash through a zeroed software
   // CRC table on non-SSE4.2 builds (silent wrong bucket indices).
   init_crc32c_table();
-  auto* res = new BatchResult();
-  res->cols.resize(n_fields);
-  res->group_bufs.resize(n_groups);
-  for (int32_t g = 0; g < n_groups; g++) {
-    res->group_bufs[g].assign((size_t)n_records * group_strides[g], 0);
-  }
-  FieldMap fields;
-  for (int32_t i = 0; i < n_fields; i++) {
-    ColBuilder& col = res->cols[i];
-    col.name = field_names[i];
-    col.layout = layouts[i];
-    col.kind = kinds[i];
-    col.dtype = dtypes[i];
-    col.nullable = nullables[i] != 0;
-    col.hash_buckets = hash_buckets ? hash_buckets[i] : 0;
-    if (group_ids && group_ids[i] >= 0) {
-      int32_t g = group_ids[i];
-      col.group_buf = res->group_bufs[g].data();
-      col.group_stride = group_strides[g];
-      col.group_off = group_offs[i];
-    }
-    col.init_offsets();
-    fields.emplace(col.name, i);
-    // Pre-size the common buffers for the batch.
-    col.mask.reserve(n_records);
-    if (col.layout != LAYOUT_SCALAR) col.row_offsets.reserve(n_records + 1);
-    if (col.group_buf) continue;  // values live in the group matrix
-    if (col.dtype == DT_BYTES) {
-      col.blob_offsets.reserve(n_records + 1);
-      col.blob.reserve((size_t)n_records * 8);
-    } else if (col.layout == LAYOUT_SCALAR) {
-      switch (col.dtype) {
-        case DT_I64: col.i64.reserve(n_records); break;
-        case DT_I32: col.i32.reserve(n_records); break;
-        case DT_F32: col.f32.reserve(n_records); break;
-        case DT_F64: col.f64.reserve(n_records); break;
-      }
-    }
-  }
-  std::vector<int32_t> seen_epoch(n_fields, -1);
-  std::vector<int32_t> seen_fl_epoch(n_fields, -1);
-  StickyOrder sticky_features, sticky_lists;
-  std::string err;
-
+  DecodeState st;
+  init_decode_state(st, n_records, record_format, n_fields, field_names,
+                    layouts, kinds, dtypes, nullables, hash_buckets,
+                    group_ids, group_offs, n_groups, group_strides);
   for (int64_t r = 0; r < n_records; r++) {
-    if (r) { sticky_features.next_record(); sticky_lists.next_record(); }
-    Cursor c{buf + rec_offsets[r], buf + rec_offsets[r] + rec_lengths[r]};
-    bool ok = true;
-    while (c.p < c.end && ok) {
-      uint64_t tag;
-      if (!read_varint(c, &tag)) { err = "truncated record tag"; ok = false; break; }
-      uint32_t fnum = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
-      if (wt == 2 && ((record_format == 0 && fnum == 1) ||
-                      (record_format == 1 && (fnum == 1 || fnum == 2)))) {
-        uint64_t mlen;
-        if (!read_varint(c, &mlen) || (uint64_t)(c.end - c.p) < mlen) { err = "truncated message"; ok = false; break; }
-        const uint8_t* ms = c.p;
-        const uint8_t* me = c.p + mlen;
-        c.p += mlen;
-        if (record_format == 1 && fnum == 2) {
-          ok = parse_feature_lists(ms, me, fields, sticky_lists, res->cols, seen_epoch, seen_fl_epoch, (int32_t)r, err);
-        } else {
-          ok = parse_features_map(ms, me, fields, sticky_features, res->cols, seen_epoch, seen_fl_epoch, (int32_t)r, err);
-        }
-      } else {
-        if (!skip_field(c, wt)) { err = "bad record field"; ok = false; }
-      }
-    }
-    if (ok) {
-      for (int32_t i = 0; i < n_fields; i++) {
-        if (seen_epoch[i] != (int32_t)r) {
-          if (!res->cols[i].nullable) {
-            err = "Field " + res->cols[i].name + " does not allow null values";
-            ok = false;
-            break;
-          }
-          append_missing(res->cols[i]);
-        }
-      }
-    }
-    if (!ok) {
-      std::snprintf(errbuf, errbuf_len, "record %lld: %s", (long long)r, err.c_str());
-      delete res;
+    if (!decode_one(st, buf + rec_offsets[r], rec_lengths[r], r, errbuf, errbuf_len)) {
+      delete st.res;
       return nullptr;
     }
   }
-  return res;
+  return st.res;
+}
+
+// Fused frame scan + decode: walk TFRecord frames from buf+start, verify
+// CRCs (when verify), skip the first skip_records complete frames
+// (scanned+verified but not decoded — the resume path), then decode up to
+// max_records records in the same pass (each record parsed immediately
+// after its CRC while its bytes are cache-hot; no offsets/lengths arrays
+// materialize at all). Stops at max_records or at a partial tail frame
+// (*consumed = absolute end of the last processed frame; not an error).
+// Returns a result handle, or nullptr with errbuf filled (prefix
+// "corrupt TFRecord"/"truncated TFRecord" = framing, else decode error).
+void* tfr_scan_decode(const uint8_t* buf, uint64_t len, uint64_t start,
+                      int32_t verify, int64_t skip_records, int64_t max_records,
+                      int32_t record_format,
+                      int32_t n_fields, const char** field_names,
+                      const int32_t* layouts, const int32_t* kinds,
+                      const int32_t* dtypes, const uint8_t* nullables,
+                      const int64_t* hash_buckets,
+                      const int32_t* group_ids, const int64_t* group_offs,
+                      int32_t n_groups, const int64_t* group_strides,
+                      int64_t* n_skipped, int64_t* n_decoded, uint64_t* consumed,
+                      char* errbuf, int64_t errbuf_len) {
+  init_crc32c_table();
+  DecodeState st;
+  init_decode_state(st, max_records, record_format, n_fields, field_names,
+                    layouts, kinds, dtypes, nullables, hash_buckets,
+                    group_ids, group_offs, n_groups, group_strides);
+  uint64_t pos = start;
+  int64_t skipped = 0, decoded = 0;
+  *consumed = start;
+  while (decoded < max_records) {
+    if (pos + 12 > len) break;  // incomplete header -> tail
+    uint64_t rec_len;
+    std::memcpy(&rec_len, buf + pos, 8);
+    uint32_t len_crc;
+    std::memcpy(&len_crc, buf + pos + 8, 4);
+    if (verify && masked_crc(buf + pos, 8) != len_crc) {
+      std::snprintf(errbuf, errbuf_len, "corrupt TFRecord: bad length CRC");
+      delete st.res;
+      return nullptr;
+    }
+    uint64_t rstart = pos + 12;
+    if (len - rstart < 4 || rec_len > len - rstart - 4) break;  // tail
+    if (verify) {
+      uint32_t data_crc;
+      std::memcpy(&data_crc, buf + rstart + rec_len, 4);
+      if (masked_crc(buf + rstart, rec_len) != data_crc) {
+        std::snprintf(errbuf, errbuf_len, "corrupt TFRecord: bad data CRC");
+        delete st.res;
+        return nullptr;
+      }
+    }
+    pos = rstart + rec_len + 4;
+    if (skipped < skip_records) {
+      skipped++;
+      *consumed = pos;
+      continue;
+    }
+    if (!decode_one(st, buf + rstart, rec_len, decoded, errbuf, errbuf_len)) {
+      delete st.res;
+      return nullptr;
+    }
+    decoded++;
+    *consumed = pos;
+  }
+  // Group matrices were sized for max_records; shrink to what decoded.
+  for (size_t g = 0; g < st.res->group_bufs.size(); g++) {
+    st.res->group_bufs[g].resize((size_t)decoded * group_strides[g]);
+  }
+  *n_skipped = skipped;
+  *n_decoded = decoded;
+  return st.res;
 }
 
 static ColBuilder* get_col(void* h, int32_t i) {

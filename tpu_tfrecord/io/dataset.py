@@ -149,42 +149,47 @@ class TFRecordDataset:
         ]
         return self._decoder.decode_batch(records)
 
+    def _read_slab(self, fh, tail: bytes, path: str) -> Optional[bytes]:
+        """Read the next slab, honoring the bounded tail-carry contract:
+        once a partial frame header is visible, the declared record length
+        caps how much more is read (one read, not repeated doubling), and a
+        declared length above ``max_record_bytes`` raises immediately — a
+        corrupt length field (possible with verify_crc=False) can never
+        buffer the rest of a huge shard before erroring. Returns
+        tail + fresh bytes, or None at clean EOF; raises on a truncated
+        trailing frame."""
+        want = self.slab_bytes
+        if len(tail) >= 8:
+            declared = int.from_bytes(tail[:8], "little")
+            if declared > self.max_record_bytes:
+                raise wire.TFRecordCorruptionError(
+                    f"record length {declared} exceeds max_record_bytes "
+                    f"({self.max_record_bytes}) in {path} — corrupt length field?"
+                )
+            want = max(want, 16 + declared - len(tail))
+        data = fh.read(want)
+        if not data:
+            if tail:
+                raise wire.TFRecordCorruptionError(
+                    f"truncated TFRecord at end of {path}"
+                )
+            return None
+        return tail + data if tail else data
+
     def _shard_slabs(self, shard) -> Iterator[tuple]:
         """Stream one shard as (buf, offsets, lengths) slabs of complete
         frames — shards larger than memory never materialize whole (the tail
         of each read carries into the next slab). Compressed shards stream
-        through the codec the same way.
-
-        The tail carry is BOUNDED: once a partial frame header is visible,
-        the declared record length caps how much more is read (one read,
-        not repeated doubling), and a declared length above
-        ``max_record_bytes`` raises immediately — a corrupt length field
-        (possible with verify_crc=False) can never buffer the rest of a
-        huge shard before erroring."""
+        through the codec the same way (bounded-carry contract in
+        ``_read_slab``)."""
         codec = wire.codec_from_path(shard.path)
         verify = self.options.verify_crc
         with wire.open_compressed(shard.path, "rb", codec) as fh:
             carry = b""
             while True:
-                want = self.slab_bytes
-                if len(carry) >= 8:
-                    # partial frame header: read exactly what it needs
-                    declared = int.from_bytes(carry[:8], "little")
-                    if declared > self.max_record_bytes:
-                        raise wire.TFRecordCorruptionError(
-                            f"record length {declared} exceeds max_record_bytes "
-                            f"({self.max_record_bytes}) in {shard.path} — "
-                            "corrupt length field?"
-                        )
-                    want = max(want, 16 + declared - len(carry))
-                data = fh.read(want)
-                if not data:
-                    if carry:
-                        raise wire.TFRecordCorruptionError(
-                            f"truncated TFRecord at end of {shard.path}"
-                        )
+                buf = self._read_slab(fh, carry, shard.path)
+                if buf is None:
                     return
-                buf = carry + data if carry else data
                 if _native.available():
                     offsets, lengths, consumed = _native.scan_partial(buf, verify)
                 else:
@@ -234,6 +239,9 @@ class TFRecordDataset:
         leans on Spark task retry): on a transient IO/corruption error the
         slab stream restarts, skipping the records already emitted — no
         duplicates, no holes."""
+        if self._native_decoder is not None:
+            yield from self._decode_shard_fused(epoch, pos, shard_idx, skip)
+            return
         from tpu_tfrecord.tracing import trace
 
         chunk_records = max(self.batch_size, 2048)
@@ -261,6 +269,58 @@ class TFRecordDataset:
                         next_index = base + stop
                     base += n
                 return
+            except (OSError, wire.TFRecordCorruptionError):
+                attempt += 1
+                if attempt > self.read_retries:
+                    raise
+                time.sleep(min(0.1 * 2**attempt, 2.0))
+
+    def _decode_shard_fused(
+        self, epoch: int, pos: int, shard_idx: int, skip: int
+    ) -> Iterator[tuple]:
+        """Fused scan+decode shard stream: ONE native pass per chunk — each
+        record is parsed immediately after its CRC while its bytes are still
+        cache-hot, and no offsets/lengths arrays materialize. Same chunk
+        positions, retry semantics, and bounded tail-carry contract as the
+        two-pass path."""
+        from tpu_tfrecord.tracing import trace
+
+        chunk_records = max(self.batch_size, 2048)
+        next_index = skip  # record index within the shard to emit next
+        attempt = 0
+        dec = self._native_decoder
+        verify = self.options.verify_crc
+        shard = self.shards[shard_idx]
+        codec = wire.codec_from_path(shard.path)
+        while True:
+            try:
+                with wire.open_compressed(shard.path, "rb", codec) as fh:
+                    to_skip = next_index
+                    abs_idx = 0  # shard record index at buffer position bpos
+                    buf = b""
+                    bpos = 0
+                    while True:
+                        buf = self._read_slab(fh, buf[bpos:], shard.path)
+                        if buf is None:
+                            return
+                        bpos = 0
+                        while True:
+                            with timed("decode", METRICS) as t, trace("tfr:decode"):
+                                cb, n_sk, n_done, consumed = dec.scan_decode(
+                                    buf, bpos, verify, to_skip, chunk_records
+                                )
+                                t.records += n_done
+                                t.bytes += consumed - bpos
+                            to_skip -= n_sk
+                            abs_idx += n_sk
+                            bpos = consumed
+                            if n_done == 0:
+                                break  # only a tail remains: refill
+                            if self._partition_fields:
+                                self._attach_partition_chunk(cb, shard_idx)
+                            yield cb, epoch, pos, abs_idx
+                            abs_idx += n_done
+                            next_index = abs_idx
             except (OSError, wire.TFRecordCorruptionError):
                 attempt += 1
                 if attempt > self.read_retries:
@@ -323,20 +383,31 @@ def _producer_loop(
     def emit_from(pending: List[list], n: int) -> bool:
         """Assemble a batch of n rows from the front of the pending chunks;
         the resume state is the position after the batch's last row."""
-        slices = []
-        need = n
-        end_pos = start
-        while need:
-            entry = pending[0]
-            chunk, consumed, epoch, cursor, chunk_start = entry
-            take = min(need, chunk.num_rows - consumed)
-            slices.append(slice_batch(chunk, consumed, consumed + take))
-            entry[1] = consumed + take
-            need -= take
-            end_pos = IteratorState(epoch, cursor, chunk_start + entry[1])
-            if entry[1] >= chunk.num_rows:
-                pending.pop(0)
-        batch = concat_batches(slices)
+        entry = pending[0]
+        chunk, consumed, epoch, cursor, chunk_start = entry
+        if consumed == 0 and chunk.num_rows == n:
+            # Aligned fast path: one decode chunk IS the batch (the common
+            # case — _decode_shard chunks at batch_size granularity), so the
+            # chunk's columnar buffers pass through without the
+            # slice_batch/concat_batches memcpy.
+            pending.pop(0)
+            batch = chunk
+            end_pos = IteratorState(epoch, cursor, chunk_start + n)
+        else:
+            slices = []
+            need = n
+            end_pos = start
+            while need:
+                entry = pending[0]
+                chunk, consumed, epoch, cursor, chunk_start = entry
+                take = min(need, chunk.num_rows - consumed)
+                slices.append(slice_batch(chunk, consumed, consumed + take))
+                entry[1] = consumed + take
+                need -= take
+                end_pos = IteratorState(epoch, cursor, chunk_start + entry[1])
+                if entry[1] >= chunk.num_rows:
+                    pending.pop(0)
+            batch = concat_batches(slices)
         while not stop.is_set():
             try:
                 out_queue.put((batch, end_pos), timeout=0.1)

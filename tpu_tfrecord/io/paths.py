@@ -79,12 +79,18 @@ def parse_partition_component(component: str) -> Optional[Tuple[str, Optional[st
     return unescape_partition_value(col), unescape_partition_value(raw)
 
 
-# Strict numeric shapes for partition-value classification. Python's
-# int()/float() are more permissive than the JVM parsing the reference rides
-# on (underscore separators '1_0', surrounding whitespace, 'inf'/'nan') —
-# those must classify as strings, or mixed datasets silently coerce.
+# Strict numeric shapes for partition-value classification, mirroring the
+# JVM parses Spark's inference rides on: Long.parseLong (no trimming, no
+# underscore separators, no 'inf') and Double.parseDouble (trims whitespace,
+# accepts exact-case 'NaN'/'Infinity'). Python's int()/float() are more
+# permissive ('1_0', lowercase 'inf'/'nan') — those must classify as
+# strings, or mixed datasets silently coerce. The Java FloatTypeSuffix
+# ('1.5f') is deliberately not accepted: the read-side cast uses Python
+# float(), which cannot parse it.
 _PARTITION_LONG_RE = re.compile(r"[+-]?\d+\Z")
-_PARTITION_DOUBLE_RE = re.compile(r"[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?\Z")
+_PARTITION_DOUBLE_RE = re.compile(
+    r"[+-]?(NaN|Infinity|(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?)\Z"
+)
 
 
 def infer_partition_type(values: Iterable[Optional[str]]) -> DataType:
@@ -96,7 +102,7 @@ def infer_partition_type(values: Iterable[Optional[str]]) -> DataType:
         if _PARTITION_LONG_RE.match(v):
             continue
         saw_long = False
-        if not _PARTITION_DOUBLE_RE.match(v):
+        if not _PARTITION_DOUBLE_RE.match(v.strip()):
             saw_double = False
             break
     if saw_long:

@@ -17,6 +17,7 @@ from tpu_tfrecord.tpu.mesh import (
 from tpu_tfrecord.tpu.ingest import (
     DeviceIterator,
     batch_spec,
+    data_shardings,
     hash_bytes_column,
     host_batch_from_columnar,
     make_global_batch,
@@ -28,6 +29,7 @@ __all__ = [
     "assign_shards",
     "local_batch_size",
     "batch_spec",
+    "data_shardings",
     "host_batch_from_columnar",
     "make_global_batch",
     "hash_bytes_column",
