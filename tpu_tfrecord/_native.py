@@ -57,6 +57,9 @@ def _build() -> None:
     tmp_path = f"{_LIB_PATH}.{os.getpid()}.tmp"
     cmd = ["g++", "-std=c++20", "-O3", "-fPIC", "-shared", "-o", tmp_path, _SRC]
     if platform.machine() == "x86_64":
+        # BMI2 (PEXT varint decode) is NOT forced here: it compiles via a
+        # per-function target attribute and dispatches at runtime on
+        # __builtin_cpu_supports, so the .so stays safe on pre-Haswell CPUs.
         cmd.insert(1, "-msse4.2")
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
@@ -116,6 +119,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.tfr_result_blob.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(u8p)]
     lib.tfr_result_mask.restype = ctypes.c_int64
     lib.tfr_result_mask.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(u8p)]
+    lib.tfr_result_trim.restype = None
+    lib.tfr_result_trim.argtypes = [ctypes.c_void_p]
     lib.tfr_result_free.restype = None
     lib.tfr_result_free.argtypes = [ctypes.c_void_p]
 
@@ -434,25 +439,25 @@ class NativeDecoder:
             if "does not allow null values" in msg:
                 raise NullValueError(msg)
             raise ValueError(f"native decode failed: {msg}")
-        try:
-            return self._extract(handle, n_records)
-        finally:
-            lib.tfr_result_free(handle)
+        return self._extract_owned(handle, n_records)
 
     def scan_decode(
         self,
-        buf: bytes,
+        buf,
         start: int,
         verify_crc: bool,
         skip_records: int,
         max_records: int,
+        length: Optional[int] = None,
     ) -> Tuple[Optional[ColumnarBatch], int, int, int]:
         """Fused frame scan + decode in ONE pass over ``buf`` from ``start``:
         CRC-verify and skip ``skip_records`` frames (resume), then decode up
         to ``max_records`` records — each parsed right after its CRC while
         its bytes are cache-hot; no offsets/lengths arrays materialize.
-        Returns (batch_or_None, n_skipped, n_decoded, consumed_abs); stops
-        without error at a partial tail frame."""
+        ``buf`` is bytes or a uint8 numpy array (reused IO buffers);
+        ``length`` bounds the valid bytes (default: whole buffer). Returns
+        (batch_or_None, n_skipped, n_decoded, consumed_abs); stops without
+        error at a partial tail frame."""
         from tpu_tfrecord.wire import TFRecordCorruptionError
 
         lib = self._lib
@@ -460,9 +465,17 @@ class NativeDecoder:
         n_sk = ctypes.c_int64(0)
         n_de = ctypes.c_int64(0)
         consumed = ctypes.c_uint64(start)
+        if isinstance(buf, np.ndarray):
+            ptr = buf.ctypes.data_as(ctypes.c_char_p)
+            blen = buf.nbytes
+        else:
+            ptr = buf
+            blen = len(buf)
+        if length is not None:
+            blen = length
         handle = lib.tfr_scan_decode(
-            buf,
-            len(buf),
+            ptr,
+            blen,
             start,
             1 if verify_crc else 0,
             skip_records,
@@ -493,9 +506,10 @@ class NativeDecoder:
                 raise NullValueError(msg)
             raise ValueError(f"native decode failed: {msg}")
         n_decoded = int(n_de.value)
-        try:
-            cb = self._extract(handle, n_decoded) if n_decoded else None
-        finally:
+        if n_decoded:
+            cb = self._extract_owned(handle, n_decoded)
+        else:
+            cb = None
             lib.tfr_result_free(handle)
         return cb, int(n_sk.value), n_decoded, int(consumed.value)
 
@@ -508,17 +522,50 @@ class NativeDecoder:
         buf = b"".join(records)
         return self.decode_spans(buf, offsets, lengths)
 
-    def _extract(self, handle, n_records: int) -> ColumnarBatch:
+    def _extract_owned(self, handle, n_records: int) -> ColumnarBatch:
+        """Extract a batch, taking ownership of ``handle``: it is freed on
+        return UNLESS zero-copy views took it over (then the last view's GC
+        frees it — even if extraction failed midway)."""
+        owner_box: List[Optional[_NativeResult]] = [None]
+        try:
+            return self._extract(handle, n_records, owner_box)
+        finally:
+            if owner_box[0] is None:
+                self._lib.tfr_result_free(handle)
+
+    def _extract(self, handle, n_records: int, owner_box) -> ColumnarBatch:
         lib = self._lib
         cols: Dict[str, Column] = {}
+        # Non-group columns are COPIED out first; then the handle is trimmed
+        # (per-column vectors dropped, group slack released) BEFORE group
+        # pointers are taken — trim may reallocate group buffers, and a
+        # pinned handle must not hold more than the group matrices.
+        self._extract_fields(handle, cols)
+        if self._group_meta:
+            lib.tfr_result_trim(handle)
         for g, (gname, np_dt, width) in enumerate(self._group_meta):
             gptr = ctypes.POINTER(ctypes.c_uint8)()
             gbytes = lib.tfr_result_group(handle, g, ctypes.byref(gptr))
-            values = _np_copy(gptr, gbytes, np_dt).reshape(n_records, width)
+            if gbytes:
+                # Zero-copy: view straight into the C++ group matrix; the
+                # result handle stays alive until the LAST view dies (the
+                # owner sits on the arrays' base chain), so the batch can
+                # flow into device_put without a host-side memcpy.
+                if owner_box[0] is None:
+                    owner_box[0] = _NativeResult(lib, handle)
+                values = _np_view(gptr, gbytes, np_dt, owner_box[0]).reshape(
+                    n_records, width
+                )
+            else:
+                values = np.empty((n_records, width), dtype=np_dt)
             # Group columns use the first member's schema dtype; per-field
             # validity is intentionally dropped (missing -> 0).
             first = self.pack[gname][0]
             cols[gname] = Column(gname, self.schema[first].data_type, values=values)
+        return ColumnarBatch(cols, n_records)
+
+    def _extract_fields(self, handle, cols: Dict[str, Column]) -> None:
+        lib = self._lib
         for i, field in enumerate(self.schema):
             if int(self._group_ids[i]) >= 0:
                 continue  # lives in a group matrix
@@ -557,7 +604,31 @@ class NativeDecoder:
                     ctypes.cast(vptr, ctypes.POINTER(ctypes.c_uint8)), vbytes, _DT_NP[dt]
                 )
             cols[field.name] = col
-        return ColumnarBatch(cols, n_records)
+
+
+class _NativeResult:
+    """Owns a BatchResult handle: freed when the last zero-copy view dies.
+    Sits at the bottom of the numpy base chain of every group-matrix view,
+    so Python's GC, not the decode call, decides when the C++ buffers go."""
+
+    __slots__ = ("_lib", "_handle")
+
+    def __init__(self, lib, handle):
+        self._lib = lib
+        self._handle = handle
+
+    def __del__(self):
+        if self._handle:
+            self._lib.tfr_result_free(self._handle)
+            self._handle = None
+
+
+def _np_view(ptr, nbytes: int, dtype, owner: "_NativeResult") -> np.ndarray:
+    """Zero-copy numpy view over a C++-owned buffer, lifetime-tied to the
+    result owner via the array base chain."""
+    raw = ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8 * nbytes)).contents
+    raw._owner = owner  # ctypes instances carry attributes; keeps owner alive
+    return np.frombuffer(raw, dtype=dtype)
 
 
 def _np_copy(ptr, nbytes: int, dtype) -> np.ndarray:

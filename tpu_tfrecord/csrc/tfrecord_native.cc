@@ -28,6 +28,9 @@
 #if defined(__SSE4_2__)
 #include <nmmintrin.h>
 #endif
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
 
 namespace {
 
@@ -142,6 +145,46 @@ uint32_t crc32c_impl(const uint8_t* p, uint64_t n, uint32_t crc) {
 #else
   return crc32c_sw(p, n, crc);
 #endif
+}
+
+// CRC32C of a short blob (categorical keys are a few bytes): straight-line
+// hardware steps, no loop setup or 3-way machinery.
+inline uint32_t crc32c_short(const uint8_t* p, uint64_t n) {
+#if defined(__SSE4_2__)
+  uint32_t crc = 0xFFFFFFFFu;
+  while (n >= 8) {
+    uint64_t w;
+    std::memcpy(&w, p, 8);
+    crc = (uint32_t)_mm_crc32_u64(crc, w);
+    p += 8;
+    n -= 8;
+  }
+  if (n >= 4) {
+    uint32_t w;
+    std::memcpy(&w, p, 4);
+    crc = _mm_crc32_u32(crc, w);
+    p += 4;
+    n -= 4;
+  }
+  if (n >= 2) {
+    uint16_t w;
+    std::memcpy(&w, p, 2);
+    crc = _mm_crc32_u16(crc, w);
+    p += 2;
+    n -= 2;
+  }
+  if (n) crc = _mm_crc32_u8(crc, *p);
+  return crc ^ 0xFFFFFFFFu;
+#else
+  return crc32c_impl(p, n, 0);
+#endif
+}
+
+// One owner for the short/long split: below crc32c_impl's 3-way block size
+// (768B) the straight-line path wins; at or above it the interleaved
+// streams do. Hashing call sites use this, never the threshold directly.
+inline uint32_t crc32c_hash(const uint8_t* p, uint64_t n) {
+  return n < 768 ? crc32c_short(p, n) : crc32c_impl(p, n, 0);
 }
 
 inline uint32_t masked_crc(const uint8_t* p, uint64_t n) {
@@ -455,7 +498,7 @@ int64_t parse_feature_values(const uint8_t* fp, const uint8_t* fend,
           if (col.hash_buckets > 0) {
             // fused categorical hashing: bytes -> embedding-row index,
             // no blob ever materialized
-            uint32_t h = crc32c_impl(lc.p, blen, 0);
+            uint32_t h = crc32c_hash(lc.p, blen);
             col.push_hashed((int32_t)(h % (uint64_t)col.hash_buckets));
           } else {
             col.push_bytes(lc.p, blen);
@@ -702,6 +745,38 @@ inline bool turbo_read_varint(const uint8_t*& p, const uint8_t* end, uint64_t* o
   return false;
 }
 
+// Branch-light varint decode: load 8 bytes, locate the terminator byte with
+// ctz over the inverted continuation bits, extract the payload bits with
+// PEXT. Covers varints up to 8 bytes (56 bits — every int32-range feature);
+// longer ones and buffer tails fall back to the byte loop. Compiled with a
+// per-function target attribute and dispatched at runtime so the library
+// never executes PEXT on a CPU without BMI2 (and the binary itself is not
+// built -mbmi2). Note: PEXT is microcoded (slow) on AMD Zen1/Zen2; the
+// expected deployment (TPU host VMs) is Intel, where it is 3 cycles.
+#if defined(__x86_64__)
+__attribute__((target("bmi2"), noinline))
+bool turbo_varint_pext(const uint8_t*& p, uint64_t* out) {
+  uint64_t w;
+  std::memcpy(&w, p, 8);
+  uint64_t term = ~w & 0x8080808080808080ULL;  // terminator high bits
+  if (!term) return false;  // >8-byte varint: caller falls back
+  int nbytes = (__builtin_ctzll(term) >> 3) + 1;
+  uint64_t mask = (nbytes == 8) ? ~0ULL : ((1ULL << (8 * nbytes)) - 1);
+  *out = _pext_u64(w & mask, 0x7F7F7F7F7F7F7F7FULL);
+  p += nbytes;
+  return true;
+}
+const bool g_has_bmi2 = __builtin_cpu_supports("bmi2");
+#endif
+
+inline bool turbo_varint_fast(const uint8_t*& p, const uint8_t* end, uint64_t* out) {
+#if defined(__x86_64__)
+  if (g_has_bmi2 && end - p >= 8 && turbo_varint_pext(p, out)) return true;
+#endif
+  return turbo_read_varint(p, end, out);
+}
+
+
 // Parse one record in turbo mode. Returns true on success (columns written,
 // caller sets seen_epoch); false = no harm done (partial writes rolled
 // back), caller re-parses generically. Slots are mutable: their adaptive
@@ -733,10 +808,12 @@ bool turbo_parse(const uint8_t* rp, const uint8_t* rend,
       ColBuilder& col = cols[s.idx];
       col.cur_row = epoch;
       if (col.kind == KIND_INT64) {
-        // value: one-varint-or-more packed run of s.value_len bytes
+        // value: one-varint-or-more packed run of s.value_len bytes. The
+        // fast varint may load past ve (within the record) — the q > ve
+        // check catches a run with no terminator, like the bounded read.
         const uint8_t* ve = q + s.value_len;
         uint64_t v;
-        if (!turbo_read_varint(q, ve, &v)) return abort_record();
+        if (!turbo_varint_fast(q, rend, &v) || q > ve) return abort_record();
         while (q < ve) {  // rest of the run: validate well-formed varints
           int cont = 0;
           while (q < ve && (*q & 0x80)) { q++; cont++; }
@@ -746,7 +823,7 @@ bool turbo_parse(const uint8_t* rp, const uint8_t* rend,
         col.push_i64((int64_t)v);
       } else if (col.kind == KIND_BYTES) {
         if (col.hash_buckets > 0) {
-          uint32_t h = crc32c_impl(q, s.value_len, 0);
+          uint32_t h = crc32c_hash(q, s.value_len);
           col.push_hashed((int32_t)(h % (uint64_t)col.hash_buckets));
         } else {
           col.push_bytes(q, s.value_len);
@@ -807,7 +884,7 @@ bool turbo_parse(const uint8_t* rp, const uint8_t* rend,
       vstart = q;
       vlen = (uint32_t)plen;
       uint64_t v;
-      if (!turbo_read_varint(q, ee, &v)) return abort_record();
+      if (!turbo_varint_fast(q, ee, &v)) return abort_record();
       // scalar head semantics: first value wins; the rest of the packed
       // run is legal but must still be well-formed varints (the generic
       // path validates them, so turbo must too)
@@ -836,7 +913,7 @@ bool turbo_parse(const uint8_t* rp, const uint8_t* rend,
       vstart = q;
       vlen = (uint32_t)blen;
       if (col.hash_buckets > 0) {
-        uint32_t h = crc32c_impl(q, blen, 0);
+        uint32_t h = crc32c_hash(q, blen);
         col.push_hashed((int32_t)(h % (uint64_t)col.hash_buckets));
       } else {
         col.push_bytes(q, blen);
@@ -1236,6 +1313,27 @@ void* tfr_scan_decode(const uint8_t* buf, uint64_t len, uint64_t start,
 
 static ColBuilder* get_col(void* h, int32_t i) {
   return &static_cast<BatchResult*>(h)->cols[i];
+}
+
+// Drop everything a long-lived handle no longer needs: per-column vectors
+// (their contents were copied to Python) and group-buffer slack capacity.
+// MUST be called BEFORE tfr_result_group hands out group pointers —
+// shrink_to_fit may reallocate. Keeps a handle pinned by zero-copy views
+// from holding more than the group matrices themselves.
+void tfr_result_trim(void* h) {
+  auto* res = static_cast<BatchResult*>(h);
+  for (auto& c : res->cols) {
+    std::vector<int64_t>().swap(c.i64);
+    std::vector<int32_t>().swap(c.i32);
+    std::vector<float>().swap(c.f32);
+    std::vector<double>().swap(c.f64);
+    std::vector<uint8_t>().swap(c.blob);
+    std::vector<int64_t>().swap(c.blob_offsets);
+    std::vector<int64_t>().swap(c.row_offsets);
+    std::vector<int64_t>().swap(c.inner_offsets);
+    std::vector<uint8_t>().swap(c.mask);
+  }
+  for (auto& g : res->group_bufs) g.shrink_to_fit();
 }
 
 int64_t tfr_result_values(void* h, int32_t i, const void** ptr) {
