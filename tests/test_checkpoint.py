@@ -52,6 +52,77 @@ def test_state_file_inside_dataset_dir_is_ignored_by_discovery(sandbox):
     assert len(tfio.read(out, schema=SCHEMA)) == 2
 
 
+class TestIdentityGuard:
+    """Resuming against a CHANGED dataset must fail loudly, never silently
+    read wrong/duplicate data (the fingerprint covers the global shard list,
+    process slot, shuffle seed, and record type)."""
+
+    def _write(self, out, n_shards=2):
+        for s in range(n_shards):
+            tfio.write([[s * 10 + i] for i in range(6)], SCHEMA, out, mode="append")
+
+    def _saved_state(self, out, tmp_path):
+        ds = TFRecordDataset(out, batch_size=6, schema=SCHEMA)
+        with ds.batches() as it:
+            next(it)
+            checkpoint.save_state(str(tmp_path), it, process_index=0)
+        return checkpoint.load_state(str(tmp_path), process_index=0)
+
+    def test_mutated_shard_list_rejected(self, sandbox, tmp_path):
+        out = str(sandbox / "mut")
+        self._write(out)
+        st = self._saved_state(out, tmp_path)
+        assert st.fingerprint is not None
+        # mutate the dataset: add a shard
+        tfio.write([[99]], SCHEMA, out, mode="append")
+        ds = TFRecordDataset(out, batch_size=6, schema=SCHEMA)
+        with pytest.raises(ValueError, match="fingerprint"):
+            ds.batches(st)
+
+    def test_different_seed_rejected(self, sandbox, tmp_path):
+        out = str(sandbox / "seed")
+        self._write(out)
+        ds = TFRecordDataset(out, batch_size=6, schema=SCHEMA, shuffle=True, seed=1)
+        with ds.batches() as it:
+            next(it)
+            st = it.state()
+        ds2 = TFRecordDataset(out, batch_size=6, schema=SCHEMA, shuffle=True, seed=2)
+        with pytest.raises(ValueError, match="fingerprint"):
+            ds2.batches(st)
+
+    def test_different_process_slot_rejected(self, sandbox, tmp_path):
+        out = str(sandbox / "slot")
+        self._write(out, n_shards=4)
+        ds = TFRecordDataset(
+            out, batch_size=6, schema=SCHEMA, process_index=0, process_count=2
+        )
+        with ds.batches() as it:
+            next(it)
+            st = it.state()
+        ds2 = TFRecordDataset(
+            out, batch_size=6, schema=SCHEMA, process_index=1, process_count=2
+        )
+        with pytest.raises(ValueError, match="fingerprint"):
+            ds2.batches(st)
+
+    def test_matching_dataset_resumes(self, sandbox, tmp_path):
+        out = str(sandbox / "ok")
+        self._write(out)
+        st = self._saved_state(out, tmp_path)
+        ds = TFRecordDataset(out, batch_size=6, schema=SCHEMA)
+        with ds.batches(st) as it:
+            got = [b["uid"].values.tolist() for b in it]
+        assert got  # resumed cleanly past the first batch
+
+    def test_legacy_state_without_fingerprint_accepted(self, sandbox):
+        out = str(sandbox / "legacy")
+        self._write(out)
+        ds = TFRecordDataset(out, batch_size=6, schema=SCHEMA)
+        legacy = IteratorState(epoch=0, shard_cursor=0, record_offset=6)
+        with ds.batches(legacy) as it:
+            assert next(it).num_rows == 6
+
+
 def test_version_check(tmp_path):
     import json
 

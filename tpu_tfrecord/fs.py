@@ -1,0 +1,199 @@
+"""Pluggable filesystem layer.
+
+The reference reads and writes through Hadoop's ``FileSystem`` — any scheme
+(HDFS, GCS, S3) for free via ``CodecStreams.createOutputStream``
+(TFRecordOutputWriter.scala:19) and the Hadoop input format
+(TFRecordFileReader.scala:24-32). Here the same pluggability comes from a
+minimal FS interface: paths with a URI scheme (``gs://``, ``s3://``,
+``memory://``, ...) route through fsspec when it is installed; plain paths
+use the standard library directly (zero overhead on the hot path).
+
+Semantics notes:
+- ``rename`` is the commit primitive. Local rename is atomic; object stores
+  have no rename, so fsspec's ``mv`` is copy+delete there — the commit is
+  then idempotent-but-not-atomic (the same tradeoff Hadoop's
+  FileOutputCommitter v2 makes on object stores).
+- Paths returned by listing/glob/walk keep their scheme prefix, so every
+  downstream consumer (codec detection, shard bookkeeping) works on full
+  URLs unchanged. This module is Linux-first: URL path arithmetic uses '/',
+  which equals ``os.sep`` everywhere this framework runs.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+import re
+import shutil
+from typing import BinaryIO, Iterator, List
+
+_SCHEME_RE = re.compile(r"^[a-zA-Z][a-zA-Z0-9+.\-]*://")
+
+
+def has_scheme(path: str) -> bool:
+    return bool(_SCHEME_RE.match(str(path)))
+
+
+class LocalFS:
+    """Standard-library filesystem — the default for plain paths."""
+
+    def normalize(self, path: str) -> str:
+        return path
+
+    def open(self, path: str, mode: str) -> BinaryIO:
+        return open(path, mode)  # noqa: SIM115
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def isfile(self, path: str) -> bool:
+        return os.path.isfile(path)
+
+    def isdir(self, path: str) -> bool:
+        return os.path.isdir(path)
+
+    def listdir(self, path: str) -> List[str]:
+        return os.listdir(path)
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def remove(self, path: str) -> None:
+        os.remove(path)
+
+    def rmtree(self, path: str, ignore_errors: bool = False) -> None:
+        shutil.rmtree(path, ignore_errors=ignore_errors)
+
+    def rmdir(self, path: str) -> None:
+        os.rmdir(path)
+
+    def rename(self, src: str, dst: str) -> None:
+        os.replace(src, dst)
+
+    def size(self, path: str) -> int:
+        return os.path.getsize(path)
+
+    def glob(self, pattern: str) -> List[str]:
+        return sorted(_glob.glob(pattern))
+
+    def walk_files(self, root: str, keep) -> Iterator[str]:
+        """Deterministic (sorted) walk of files under root, descending only
+        into directories ``keep`` accepts and yielding only files it accepts."""
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames if keep(d))
+            for f in sorted(filenames):
+                if keep(f):
+                    yield os.path.join(dirpath, f)
+
+    def touch(self, path: str) -> None:
+        with open(path, "wb"):
+            pass
+
+
+class FsspecFS:
+    """fsspec-backed filesystem for scheme'd URLs. All returned paths carry
+    the scheme prefix (``fs.unstrip_protocol``)."""
+
+    def __init__(self, url: str):
+        import fsspec
+
+        self._fs, _ = fsspec.core.url_to_fs(url)
+
+    def _strip(self, path: str) -> str:
+        return self._fs._strip_protocol(path)
+
+    def _unstrip(self, path: str) -> str:
+        return self._fs.unstrip_protocol(path)
+
+    def normalize(self, path: str) -> str:
+        """Canonical URL form — listing/walk results are unstripped, so
+        callers comparing against an input root must normalize it the same
+        way (e.g. ``memory:///x`` vs ``memory://x``)."""
+        return self._unstrip(self._strip(path))
+
+    def open(self, path: str, mode: str) -> BinaryIO:
+        return self._fs.open(self._strip(path), mode)
+
+    def exists(self, path: str) -> bool:
+        return self._fs.exists(self._strip(path))
+
+    def isfile(self, path: str) -> bool:
+        return self._fs.isfile(self._strip(path))
+
+    def isdir(self, path: str) -> bool:
+        return self._fs.isdir(self._strip(path))
+
+    def listdir(self, path: str) -> List[str]:
+        base = self._strip(path)
+        return sorted(
+            p.rstrip("/").rsplit("/", 1)[-1]
+            for p in self._fs.ls(base, detail=False)
+            if p.rstrip("/") != base.rstrip("/")
+        )
+
+    def makedirs(self, path: str) -> None:
+        self._fs.makedirs(self._strip(path), exist_ok=True)
+
+    def remove(self, path: str) -> None:
+        self._fs.rm_file(self._strip(path))
+
+    def rmtree(self, path: str, ignore_errors: bool = False) -> None:
+        try:
+            self._fs.rm(self._strip(path), recursive=True)
+        except Exception:
+            if not ignore_errors:
+                raise
+
+    def rmdir(self, path: str) -> None:
+        # Object stores have no real directories; an "empty dir" marker may
+        # not even exist. Only remove when actually empty, like os.rmdir.
+        sp = self._strip(path)
+        if self._fs.exists(sp):
+            if self._fs.ls(sp, detail=False):
+                raise OSError(f"Directory not empty: {path}")
+            self._fs.rmdir(sp)
+
+    def rename(self, src: str, dst: str) -> None:
+        # copy+delete on stores without native rename (see module docstring)
+        self._fs.mv(self._strip(src), self._strip(dst))
+
+    def size(self, path: str) -> int:
+        return self._fs.size(self._strip(path))
+
+    def glob(self, pattern: str) -> List[str]:
+        return sorted(
+            self._unstrip(p) for p in self._fs.glob(self._strip(pattern))
+        )
+
+    def walk_files(self, root: str, keep) -> Iterator[str]:
+        # on_error="raise": a listing failure (transient 5xx, permissions)
+        # must surface, not silently drop a subtree of shards — training on
+        # partial data with no error is the worst outcome.
+        for dirpath, dirnames, filenames in self._fs.walk(
+            self._strip(root), on_error="raise"
+        ):
+            dirnames[:] = sorted(d for d in dirnames if keep(d))
+            for f in sorted(filenames):
+                if keep(f):
+                    yield self._unstrip(dirpath.rstrip("/") + "/" + f)
+
+    def touch(self, path: str) -> None:
+        self._fs.touch(self._strip(path))
+
+
+_LOCAL = LocalFS()
+
+
+def filesystem_for(path: str):
+    """The FS for a path: fsspec for scheme'd URLs, the standard library
+    otherwise. Scheme'd paths without fsspec installed raise with a clear
+    message (fsspec is an optional dependency)."""
+    if has_scheme(os.fspath(path)):
+        try:
+            return FsspecFS(os.fspath(path))
+        except ImportError as e:
+            raise ImportError(
+                f"path {path!r} has a URL scheme, which requires the optional "
+                "fsspec dependency (pip install fsspec)"
+            ) from e
+    return _LOCAL

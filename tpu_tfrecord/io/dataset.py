@@ -16,11 +16,13 @@ needs (the Grain-style plan from SURVEY.md §5):
 
 from __future__ import annotations
 
+import hashlib
+import json
 import queue
 import threading
 import time
 import weakref
-from dataclasses import asdict, dataclass
+from dataclasses import dataclass, field, replace
 from typing import Any, Dict, Iterator, List, Optional
 
 import numpy as np
@@ -45,17 +47,32 @@ class IteratorState:
     """Grain-style resumable position. ``shard_cursor`` is the POSITION in
     the epoch's iteration order over this host's shard list (identity order,
     or the (seed, epoch)-derived permutation when shuffling);
-    ``record_offset`` counts records already consumed from that shard."""
+    ``record_offset`` counts records already consumed from that shard.
+
+    ``fingerprint`` identifies the dataset the position is valid FOR (global
+    shard list + process slot + shuffle seed + record type): resuming
+    against a changed dataset raises loudly instead of silently reading
+    wrong or duplicate data. None (e.g. states from older checkpoints) skips
+    the check. Excluded from equality — two states at the same position are
+    the same position."""
 
     epoch: int = 0
     shard_cursor: int = 0
     record_offset: int = 0
+    fingerprint: Optional[str] = field(default=None, compare=False)
 
-    def to_json(self) -> Dict[str, int]:
-        return asdict(self)
+    def to_json(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "epoch": self.epoch,
+            "shard_cursor": self.shard_cursor,
+            "record_offset": self.record_offset,
+        }
+        if self.fingerprint is not None:
+            out["fingerprint"] = self.fingerprint
+        return out
 
     @staticmethod
-    def from_json(obj: Dict[str, int]) -> "IteratorState":
+    def from_json(obj: Dict[str, Any]) -> "IteratorState":
         return IteratorState(**obj)
 
 
@@ -108,6 +125,9 @@ class TFRecordDataset:
         self._data_schema = StructType([f for f in wanted if f.name not in part_cols])
         self._partition_fields = [f for f in wanted if f.name in part_cols]
         all_shards = self._reader.shards
+        self.process_index = process_index
+        self.process_count = process_count
+        self._fingerprint: Optional[str] = None
         self.shards = [
             sh for i, sh in enumerate(all_shards) if i % process_count == process_index
         ]
@@ -422,11 +442,42 @@ class TFRecordDataset:
                 col.values = np.full(n, val if val is not None else 0, dtype=np_dt)
             chunk.columns[f.name] = col
 
+    # -- identity ------------------------------------------------------------
+
+    def fingerprint(self) -> str:
+        """Digest of everything a resume position depends on: the GLOBAL
+        shard list (paths + sizes), this host's process slot, the shuffle
+        configuration, and the record type. A saved IteratorState carries
+        this; resuming against a dataset with a different fingerprint raises
+        instead of silently skewing."""
+        if self._fingerprint is None:
+            ident = {
+                "shards": [(sh.path, sh.size) for sh in self._reader.shards],
+                "process_index": self.process_index,
+                "process_count": self.process_count,
+                "shuffle": self.shuffle,
+                "seed": self.seed,
+                "record_type": self.options.record_type.value,
+            }
+            blob = json.dumps(ident, sort_keys=True).encode()
+            self._fingerprint = hashlib.sha256(blob).hexdigest()[:32]
+        return self._fingerprint
+
     # -- batched iteration ---------------------------------------------------
 
     def batches(
         self, state: Optional[IteratorState] = None
     ) -> "CheckpointableIterator":
+        if state is not None and state.fingerprint is not None:
+            mine = self.fingerprint()
+            if state.fingerprint != mine:
+                raise ValueError(
+                    "iterator state does not match this dataset (fingerprint "
+                    f"{state.fingerprint} != {mine}): the shard list, "
+                    "process slot, shuffle seed, or record type changed "
+                    "since the state was saved — resuming would read wrong "
+                    "or duplicate data"
+                )
         return CheckpointableIterator(self, state or IteratorState())
 
 
@@ -660,7 +711,9 @@ class CheckpointableIterator:
         return batch
 
     def state(self) -> IteratorState:
-        return self._consumed_state
+        """Resume position of the last batch YIELDED, stamped with the
+        dataset fingerprint so a later resume validates identity."""
+        return replace(self._consumed_state, fingerprint=self._ds.fingerprint())
 
     def close(self) -> None:
         self._stop.set()

@@ -145,28 +145,25 @@ def is_data_file(name: str) -> bool:
     return not (name.startswith("_") or name.startswith("."))
 
 
-def _walk_data_files(root: str) -> Iterable[str]:
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = sorted(d for d in dirnames if is_data_file(d))
-        for f in sorted(filenames):
-            if is_data_file(f):
-                yield os.path.join(dirpath, f)
-
-
 def expand_paths(paths) -> List[str]:
-    """Expand files/dirs/globs into a flat list of concrete roots."""
+    """Expand files/dirs/globs into a flat list of concrete roots. Scheme'd
+    URLs (``gs://``, ``memory://``, ...) expand through the pluggable FS
+    layer (the reference gets this from Hadoop's FileSystem.globStatus)."""
+    from tpu_tfrecord import fs as _fs
+
     if isinstance(paths, (str, os.PathLike)):
         paths = [paths]
     out: List[str] = []
     for p in paths:
         p = os.fspath(p)
+        fsys = _fs.filesystem_for(p)
         if _glob.has_magic(p):
-            matches = sorted(_glob.glob(p))
+            matches = fsys.glob(p)
             if not matches:
                 raise FileNotFoundError(f"Path does not match any files: {p}")
             out.extend(matches)
         else:
-            if not os.path.exists(p):
+            if not fsys.exists(p):
                 raise FileNotFoundError(f"Path does not exist: {p}")
             out.append(p)
     return out
@@ -179,20 +176,23 @@ def discover_shards(paths) -> List[Shard]:
     Deterministic order (sorted walk) — the global shard order every host
     must agree on for multi-host ingestion (SURVEY.md §5 checkpoint plan).
     """
+    from tpu_tfrecord import fs as _fs
+
     shards: List[Shard] = []
     for root in expand_paths(paths):
-        if os.path.isfile(root):
-            shards.append(Shard(root, os.path.getsize(root)))
+        fsys = _fs.filesystem_for(root)
+        if fsys.isfile(root):
+            shards.append(Shard(root, fsys.size(root)))
             continue
-        for fpath in _walk_data_files(root):
-            rel = os.path.relpath(os.path.dirname(fpath), root)
+        root_norm = fsys.normalize(root).rstrip("/")
+        for fpath in fsys.walk_files(root, is_data_file):
+            rel = os.path.dirname(fpath)[len(root_norm) :].strip("/")
             pvals: List[Tuple[str, Optional[str]]] = []
-            if rel != ".":
-                for comp in rel.split(os.sep):
-                    parsed = parse_partition_component(comp)
-                    if parsed is not None:
-                        pvals.append(parsed)
-            shards.append(Shard(fpath, os.path.getsize(fpath), tuple(pvals)))
+            for comp in rel.split("/"):
+                parsed = parse_partition_component(comp) if comp else None
+                if parsed is not None:
+                    pvals.append(parsed)
+            shards.append(Shard(fpath, fsys.size(fpath), tuple(pvals)))
     return shards
 
 
@@ -213,9 +213,14 @@ def new_shard_filename(task_id: int, ext: str, job_uuid: Optional[str] = None) -
 
 
 def has_success_marker(path: str) -> bool:
-    return os.path.exists(os.path.join(path, SUCCESS_FILE))
+    from tpu_tfrecord import fs as _fs
+
+    target = os.path.join(path, SUCCESS_FILE)
+    return _fs.filesystem_for(target).exists(target)
 
 
 def write_success_marker(path: str) -> None:
-    with open(os.path.join(path, SUCCESS_FILE), "wb"):
-        pass
+    from tpu_tfrecord import fs as _fs
+
+    target = os.path.join(path, SUCCESS_FILE)
+    _fs.filesystem_for(target).touch(target)

@@ -20,11 +20,10 @@ TFRecordOutputWriter do together —
 from __future__ import annotations
 
 import os
-import shutil
 import uuid
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
-from tpu_tfrecord import wire
+from tpu_tfrecord import fs as _fs, wire
 from tpu_tfrecord.io import paths as p
 from tpu_tfrecord.metrics import METRICS, timed
 from tpu_tfrecord.options import TFRecordOptions
@@ -85,6 +84,11 @@ class DatasetWriter:
         if mode not in SAVE_MODES:
             raise ValueError(f"Unknown save mode {mode!r}; one of {SAVE_MODES}")
         self.output_path = os.fspath(output_path)
+        # The pluggable FS (the reference's Hadoop FileSystem seam): local
+        # paths use the standard library; URLs go through fsspec. On object
+        # stores without atomic rename the commit is copy+delete (see
+        # tpu_tfrecord.fs docstring).
+        self.fs = _fs.filesystem_for(self.output_path)
         self.options = options or TFRecordOptions()
         self.mode = mode
         self.partition_by = list(partition_by or [])
@@ -120,7 +124,8 @@ class DatasetWriter:
         (mode=ignore with existing output). Existence means PATH existence —
         an empty directory counts, matching Spark's save-mode checks."""
         out = self.output_path
-        exists = os.path.exists(out)
+        fs = self.fs
+        exists = fs.exists(out)
         if exists:
             if self.mode in ("error", "errorifexists"):
                 raise FileExistsError(
@@ -129,24 +134,24 @@ class DatasetWriter:
             if self.mode == "ignore":
                 return False
             if self.mode == "overwrite":
-                if os.path.isdir(out):
+                if fs.isdir(out):
                     # delete data and markers but PRESERVE the _temporary
                     # subtree: other jobs may have shards in flight there
-                    for entry in os.listdir(out):
+                    for entry in fs.listdir(out):
                         if entry == p.TEMP_PREFIX:
                             continue
                         fp = os.path.join(out, entry)
-                        if os.path.isdir(fp):
-                            shutil.rmtree(fp)
+                        if fs.isdir(fp):
+                            fs.rmtree(fp)
                         else:
-                            os.remove(fp)
+                            fs.remove(fp)
                 else:
-                    os.remove(out)
+                    fs.remove(out)
         # remember whether THIS job created the output dir so abort() can
         # undo it — a leftover empty dir would flip error/ignore semantics
         # on retry now that existence is path-based
         self._created_output = not exists
-        os.makedirs(out, exist_ok=True)
+        fs.makedirs(out)
         return True
 
     # -- the write job ------------------------------------------------------
@@ -194,11 +199,11 @@ class DatasetWriter:
             return list(row)
         return [row[i] for i in self._didx]
 
-    @staticmethod
-    def _commit_shard(tmp_path: str, final_path: str) -> None:
-        """Idempotent shard commit: atomic rename into place."""
-        os.makedirs(os.path.dirname(final_path), exist_ok=True)
-        os.replace(tmp_path, final_path)
+    def _commit_shard(self, tmp_path: str, final_path: str) -> None:
+        """Idempotent shard commit: rename into place (atomic locally;
+        copy+delete on object stores without rename)."""
+        self.fs.makedirs(os.path.dirname(final_path))
+        self.fs.rename(tmp_path, final_path)
 
     def write_batches(self, batches, task_id: int = 0) -> List[str]:
         """Write ColumnarBatches (the fast columnar path for Example and
@@ -218,13 +223,14 @@ class _WriteJob:
         self.writer = writer
         self.task_id = task_id
         self.job_id = uuid.uuid4().hex[:12]
+        self.fs = writer.fs
         self.temp_root = os.path.join(writer.output_path, p.TEMP_PREFIX, self.job_id)
         # Concurrent jobs share the _temporary parent and a finishing job
         # opportunistically rmdirs it: makedirs can lose the race between
         # creating the parent and the job dir — retry, it converges.
         for _ in range(20):
             try:
-                os.makedirs(self.temp_root, exist_ok=True)
+                self.fs.makedirs(self.temp_root)
                 break
             except FileNotFoundError:
                 continue
@@ -240,7 +246,7 @@ class _WriteJob:
         self._seq[rel] = n + 1
         fname = p.new_shard_filename(self.task_id, f".c{n:03d}{self.ext}", self.job_id)
         tmp_dir = os.path.join(self.temp_root, rel) if rel else self.temp_root
-        os.makedirs(tmp_dir, exist_ok=True)
+        self.fs.makedirs(tmp_dir)
         tmp_path = os.path.join(tmp_dir, fname)
         final_dir = (
             os.path.join(self.writer.output_path, rel)
@@ -260,10 +266,10 @@ class _WriteJob:
         for tmp_path in self._pending:
             self.writer._commit_shard(tmp_path, self._final_of[tmp_path])
             written.append(self._final_of[tmp_path])
-        shutil.rmtree(self.temp_root, ignore_errors=True)
+        self.fs.rmtree(self.temp_root, ignore_errors=True)
         try:
             # only removable once no other job is using the shared parent
-            os.rmdir(os.path.join(self.writer.output_path, p.TEMP_PREFIX))
+            self.fs.rmdir(os.path.join(self.writer.output_path, p.TEMP_PREFIX))
         except OSError:
             pass
         if self.writer.write_success:
@@ -271,16 +277,16 @@ class _WriteJob:
         return written
 
     def abort(self) -> None:
-        shutil.rmtree(self.temp_root, ignore_errors=True)
+        self.fs.rmtree(self.temp_root, ignore_errors=True)
         # if this job created the output dir, remove it again when empty so
         # a retry sees the same save-mode world as the first attempt
         if getattr(self.writer, "_created_output", False):
             try:
-                os.rmdir(os.path.join(self.writer.output_path, p.TEMP_PREFIX))
+                self.fs.rmdir(os.path.join(self.writer.output_path, p.TEMP_PREFIX))
             except OSError:
                 pass
             try:
-                os.rmdir(self.writer.output_path)
+                self.fs.rmdir(self.writer.output_path)
             except OSError:
                 pass
 

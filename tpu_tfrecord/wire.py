@@ -166,12 +166,42 @@ def codec_from_path(path: str) -> Optional[str]:
 
 
 def open_compressed(path: str, mode: str, codec: Optional[str]) -> BinaryIO:
+    """Open a (possibly compressed) record stream. Paths with a URL scheme
+    route through the pluggable filesystem layer (tpu_tfrecord.fs — the
+    reference's Hadoop FileSystem + CodecStreams equivalent,
+    TFRecordOutputWriter.scala:19); the codec wraps the raw stream either
+    way."""
     codec = normalize_codec(codec)
+    from tpu_tfrecord import fs as _fs
+
+    if _fs.has_scheme(path):
+        raw = _fs.filesystem_for(path).open(path, mode)
+    elif codec is None:
+        return open(path, mode)  # noqa: SIM115  (local fast path)
+    else:
+        raw = open(path, mode)  # noqa: SIM115
     if codec == "gzip":
-        return gzip.open(path, mode)  # type: ignore[return-value]
+        return _ClosingGzip(raw, mode)  # type: ignore[return-value]
     if codec == "deflate":
-        return _DeflateFile(path, mode)
-    return open(path, mode)  # noqa: SIM115
+        return _DeflateFile(path, mode, fileobj=raw)
+    return raw
+
+
+class _ClosingGzip(gzip.GzipFile):
+    """GzipFile that also closes the underlying stream — GzipFile(fileobj=)
+    deliberately leaves it open, but remote-FS writers only upload on
+    close."""
+
+    def __init__(self, raw: BinaryIO, mode: str):
+        super().__init__(fileobj=raw, mode=mode)
+        self._raw = raw
+
+    def close(self) -> None:
+        try:
+            super().close()
+        finally:
+            if not self._raw.closed:
+                self._raw.close()
 
 
 class _DeflateFile(io.RawIOBase):
@@ -185,16 +215,16 @@ class _DeflateFile(io.RawIOBase):
 
     _READ_CHUNK = 1 << 20  # compressed bytes per underlying read
 
-    def __init__(self, path: str, mode: str):
+    def __init__(self, path: str, mode: str, fileobj: Optional[BinaryIO] = None):
         super().__init__()
         self._mode = mode
         self._path = path
         if "w" in mode:
-            self._fh = open(path, "wb")
+            self._fh = fileobj if fileobj is not None else open(path, "wb")
             self._compress = zlib.compressobj()
             self._decompress = None
         else:
-            self._fh = open(path, "rb")
+            self._fh = fileobj if fileobj is not None else open(path, "rb")
             self._compress = None
             self._decompress = zlib.decompressobj()
             self._pending = bytearray()
