@@ -79,6 +79,35 @@ class TestDotInteraction:
             np.asarray(got), np.asarray(dot_interaction_reference(emb)), rtol=1e-6
         )
 
+    def test_large_f_tiles_pair_dim(self):
+        """P-tiled grid: F=64 gives P=2016 pairs, forcing multiple pair
+        tiles (and padding) under a small block_p — results must still
+        match the reference exactly (the pre-tiling kernel OOM'd VMEM
+        here on real hardware)."""
+        emb = make_emb(b=16, f=64, d=8)
+        got = dot_interaction_pallas(emb, block_b=8, block_p=512, interpret=True)
+        want = dot_interaction_reference(emb)
+        assert got.shape == (16, 64 * 63 // 2)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4)
+
+    def test_auto_block_b_shrink_preserves_divisibility(self):
+        """b=20 with a huge D forces the VMEM-budget shrink; the shrink must
+        land on a divisor of b or trailing rows silently vanish from the
+        grid (regression: 20 -> 8 left rows 16-19 garbage)."""
+        emb = make_emb(b=20, f=8, d=1024)
+        got = dot_interaction_pallas(emb, block_b=20, interpret=True)
+        want = dot_interaction_reference(emb)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-3, atol=1e-2
+        )
+
+    def test_auto_block_p_budgeted(self):
+        # auto-sizing must pick a lane-multiple tile and still be exact
+        emb = make_emb(b=16, f=40, d=32)
+        got = dot_interaction_pallas(emb, block_b=8, interpret=True)
+        want = dot_interaction_reference(emb)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4)
+
 
 class TestDLRMDotInteraction:
     def test_training_decreases_loss(self):

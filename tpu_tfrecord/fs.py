@@ -76,14 +76,26 @@ class LocalFS:
     def glob(self, pattern: str) -> List[str]:
         return sorted(_glob.glob(pattern))
 
-    def walk_files(self, root: str, keep) -> Iterator[str]:
-        """Deterministic (sorted) walk of files under root, descending only
-        into directories ``keep`` accepts and yielding only files it accepts."""
-        for dirpath, dirnames, filenames in os.walk(root):
-            dirnames[:] = sorted(d for d in dirnames if keep(d))
-            for f in sorted(filenames):
-                if keep(f):
-                    yield os.path.join(dirpath, f)
+    def walk_files(self, root: str, keep):
+        """Deterministic (sorted) walk yielding (path, size) for files under
+        root, descending only into directories ``keep`` accepts and yielding
+        only files it accepts. Sizes come from the directory listing
+        (scandir stat) — no per-file stat round."""
+        stack = [root]
+        while stack:
+            dirpath = stack.pop()
+            files, dirs = [], []
+            with os.scandir(dirpath) as entries:
+                for e in entries:
+                    if not keep(e.name):
+                        continue
+                    if e.is_dir(follow_symlinks=True):
+                        dirs.append(e.path)
+                    else:
+                        files.append((e.path, e.stat().st_size))
+            for fpath, size in sorted(files):
+                yield fpath, size
+            stack.extend(sorted(dirs, reverse=True))  # pop() visits in order
 
     def touch(self, path: str) -> None:
         with open(path, "wb"):
@@ -165,17 +177,28 @@ class FsspecFS:
             self._unstrip(p) for p in self._fs.glob(self._strip(pattern))
         )
 
-    def walk_files(self, root: str, keep) -> Iterator[str]:
-        # on_error="raise": a listing failure (transient 5xx, permissions)
-        # must surface, not silently drop a subtree of shards — training on
-        # partial data with no error is the worst outcome.
-        for dirpath, dirnames, filenames in self._fs.walk(
-            self._strip(root), on_error="raise"
+    def walk_files(self, root: str, keep):
+        """(path, size) pairs; sizes come from walk's detail listing — one
+        list call per directory, not one HEAD per shard (thousands of serial
+        round-trips on object stores otherwise).
+
+        on_error="raise": a listing failure (transient 5xx, permissions)
+        must surface, not silently drop a subtree of shards — training on
+        partial data with no error is the worst outcome."""
+        for dirpath, dirs, files in self._fs.walk(
+            self._strip(root), detail=True, on_error="raise"
         ):
-            dirnames[:] = sorted(d for d in dirnames if keep(d))
-            for f in sorted(filenames):
+            # detail=True yields name->info dicts; prune by deleting keys
+            # (the walk recurses over what remains)
+            for d in [d for d in dirs if not keep(d)]:
+                del dirs[d]
+            for f in sorted(files):
                 if keep(f):
-                    yield self._unstrip(dirpath.rstrip("/") + "/" + f)
+                    info = files[f]
+                    yield (
+                        self._unstrip(dirpath.rstrip("/") + "/" + f),
+                        int(info.get("size") or 0),
+                    )
 
     def touch(self, path: str) -> None:
         self._fs.touch(self._strip(path))
@@ -190,10 +213,13 @@ def filesystem_for(path: str):
     message (fsspec is an optional dependency)."""
     if has_scheme(os.fspath(path)):
         try:
-            return FsspecFS(os.fspath(path))
+            import fsspec  # noqa: F401
         except ImportError as e:
             raise ImportError(
                 f"path {path!r} has a URL scheme, which requires the optional "
                 "fsspec dependency (pip install fsspec)"
             ) from e
+        # other ImportErrors (e.g. missing s3fs/gcsfs protocol package)
+        # propagate with fsspec's own actionable message
+        return FsspecFS(os.fspath(path))
     return _LOCAL

@@ -185,14 +185,14 @@ def discover_shards(paths) -> List[Shard]:
             shards.append(Shard(root, fsys.size(root)))
             continue
         root_norm = fsys.normalize(root).rstrip("/")
-        for fpath in fsys.walk_files(root, is_data_file):
+        for fpath, fsize in fsys.walk_files(root, is_data_file):
             rel = os.path.dirname(fpath)[len(root_norm) :].strip("/")
             pvals: List[Tuple[str, Optional[str]]] = []
             for comp in rel.split("/"):
                 parsed = parse_partition_component(comp) if comp else None
                 if parsed is not None:
                     pvals.append(parsed)
-            shards.append(Shard(fpath, fsys.size(fpath), tuple(pvals)))
+            shards.append(Shard(fpath, fsize, tuple(pvals)))
     return shards
 
 

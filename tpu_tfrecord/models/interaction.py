@@ -20,11 +20,17 @@ not the hot path for inference-heavy recommenders.
 `dot_interaction` picks the Pallas kernel on TPU backends and the XLA
 reference elsewhere (or under `interpret=True` for CPU tests).
 
-Measured on one v5e chip (B=1024, F=27, D=32, bf16): parity with XLA's
-fused path (~1.5ms/call both) — at this F the XLA gather fusion is already
-good; the kernel's win is keeping the Gram block VMEM-resident (no [B,F,F]
-HBM round-trip), which grows with F, plus serving as the template for
-fusing more of the interaction stack.
+Performance (measured on one v5e chip, bf16; see PARITY.md for the full
+table and method): at DLRM-regime F (Criteo F=27) the kernel is at parity
+to ~1.2x vs XLA's fused einsum+gather in wall-clock microbenchmarks, and
+the op itself is tens of microseconds at B=8192 — a trivial slice of a
+training step either way. The selection-matmul formulation does
+~F/2 x the Gram FLOPs (two [F,P] one-hot contractions vs one [F,F] Gram),
+so it LOSES to XLA at F >= 64 even though the P-tiled grid keeps VMEM
+bounded; auto-dispatch therefore uses Pallas only for F <= 32 and XLA's
+path otherwise. The kernel's structural value at small F is keeping the
+Gram block VMEM-resident (no [B,F,F] HBM round-trip) and serving as the
+fusion template for the interaction stack.
 """
 
 from __future__ import annotations
@@ -69,12 +75,19 @@ def _interaction_kernel(sel_rows_ref, sel_cols_ref, emb_ref, out_ref):
 
 
 def dot_interaction_pallas(
-    emb: jax.Array, block_b: int = 128, interpret: bool = False
+    emb: jax.Array,
+    block_b: int = 128,
+    block_p: Optional[int] = None,
+    interpret: bool = False,
 ) -> jax.Array:
     """Pallas kernel: [B, F, D] -> [B, P] with P = F*(F-1)/2.
 
     B must be divisible by ``block_b`` (pad the batch otherwise — the ingest
     layer produces fixed batch sizes, so callers control this statically).
+    The pair dimension P is tiled too (``block_p``, auto-sized to a VMEM
+    budget): the dominant allocations are the two [TB, D, TP] f32 selection
+    products, so large F (P grows as F^2) scales by shrinking TP/TB instead
+    of spilling — the [B, F, F] Gram tensor still never exists in HBM.
     """
     import math
 
@@ -91,24 +104,49 @@ def dot_interaction_pallas(
         )
     rows, cols = _tril_indices(f)
     p = len(rows)
-    # one-hot selection matrices [F, P]: column k picks feature rows[k]
-    # (resp. cols[k])
-    sel_rows = np.zeros((f, p), dtype=np.float32)
+    if block_p is None:
+        # budget for the two [TB, D, TP] f32 intermediates; shrink TB first
+        # so TP stays a full lane multiple
+        budget = 6 << 20
+
+        def tp_for(tb: int) -> int:
+            return (budget // (2 * tb * d * 4) // 128) * 128
+
+        while block_b > 8 and tp_for(block_b) < 128:
+            # shrink along DIVISORS of b only — a non-divisor tile would
+            # floor-drop trailing batch rows from the grid (silent garbage)
+            cands = [k for k in range(8, block_b) if b % k == 0]
+            if not cands:
+                break
+            block_b = max(cands)
+        # the 128 floor may exceed the budget for extreme D*P at this
+        # block_b; results stay correct and real hardware fails loudly at
+        # compile rather than silently
+        block_p = max(128, tp_for(block_b))
+    p_pad = -(-p // block_p) * block_p
+    # one-hot selection matrices [F, P_pad]: column k picks feature rows[k]
+    # (resp. cols[k]); padded columns are all-zero -> zero dots, sliced off
+    sel_rows = np.zeros((f, p_pad), dtype=np.float32)
     sel_rows[rows, np.arange(p)] = 1.0
-    sel_cols = np.zeros((f, p), dtype=np.float32)
+    sel_cols = np.zeros((f, p_pad), dtype=np.float32)
     sel_cols[cols, np.arange(p)] = 1.0
-    return pl.pallas_call(
+    out = pl.pallas_call(
         _interaction_kernel,
-        out_shape=jax.ShapeDtypeStruct((b, p), emb.dtype),
-        grid=(b // block_b,),
+        out_shape=jax.ShapeDtypeStruct((b, p_pad), emb.dtype),
+        grid=(b // block_b, p_pad // block_p),
         in_specs=[
-            pl.BlockSpec((f, p), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((f, p), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_b, f, d), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((f, block_p), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((f, block_p), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (block_b, f, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
         ],
-        out_specs=pl.BlockSpec((block_b, p), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_specs=pl.BlockSpec(
+            (block_b, block_p), lambda i, j: (i, j), memory_space=pltpu.VMEM
+        ),
         interpret=interpret,
     )(jnp.asarray(sel_rows), jnp.asarray(sel_cols), emb)
+    return out[:, :p] if p_pad != p else out
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
@@ -126,7 +164,14 @@ def dot_interaction(emb: jax.Array, use_pallas: Optional[bool] = None,
 
 def _forward(emb, use_pallas, block_b, interpret):
     if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu" and jax.device_count() == 1
+        # F <= 32: the selection-matmul formulation's FLOP overhead
+        # (~F/2 x Gram) is small and the VMEM-resident Gram wins; beyond
+        # that XLA's einsum+gather is faster (module docstring).
+        use_pallas = (
+            jax.default_backend() == "tpu"
+            and jax.device_count() == 1
+            and emb.shape[1] <= 32
+        )
     if use_pallas:
         return dot_interaction_pallas(emb, block_b=block_b, interpret=interpret)
     return dot_interaction_reference(emb)
