@@ -164,17 +164,100 @@ def main() -> None:
 
     elapsed = max(t_end - t_start, 1e-9)
     value = examples / elapsed
-    print(
-        json.dumps(
-            {
-                "metric": "criteo_tf_example_ingest_to_device",
-                "value": round(value, 1),
-                "unit": "examples/sec/host",
-                "vs_baseline": round(value / 1_000_000, 4),
-                "duty_cycle": round(duty.value() or 0.0, 4),
-            }
-        )
+
+    # Phase 2 — the BASELINE.md duty-cycle metric measured the way it is
+    # defined: a real DLRM training step on the device consuming ingested
+    # batches, busy = device step time, wait = time blocked on input. The
+    # producer thread decodes (GIL released) while the device computes, so
+    # overlap is real even on this 1-core host.
+    train_duty = None
+    if os.environ.get("TFR_BENCH_TRAIN", "1") != "0":
+        train_duty = _train_duty_cycle(ds, mesh, hash_buckets, pack)
+
+    out = {
+        "metric": "criteo_tf_example_ingest_to_device",
+        "value": round(value, 1),
+        "unit": "examples/sec/host",
+        "vs_baseline": round(value / 1_000_000, 4),
+        # transfer-hidden fraction of the ingest-only loop (phase 1)
+        "ingest_duty_cycle": round(duty.value() or 0.0, 4),
+    }
+    if train_duty is not None:
+        # the BASELINE.md >=95% target metric (phase 2)
+        out["duty_cycle"] = round(train_duty, 4)
+    print(json.dumps(out))
+
+
+def _train_duty_cycle(ds, mesh, hash_buckets, pack, seconds=6.0):
+    """Duty cycle of a DLRM train loop fed by the live pipeline."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_tfrecord.models import DLRMConfig, init_params, train_step
+    from tpu_tfrecord.tpu import DeviceIterator, host_batch_from_columnar
+    from tpu_tfrecord.tracing import DutyCycle
+
+    # Modest embedding tables: train_step takes DENSE embedding grads (no
+    # sparse-update op), so a 1M-row table would make each step an
+    # artificial multi-GB update and flatter the duty cycle. 128k rows keeps
+    # the step realistic (~ms); indices fold on device below.
+    vocab = 1 << 17
+    cfg = DLRMConfig(
+        num_dense=13,
+        num_categorical=26,
+        vocab_size=vocab,
+        embed_dim=32,
+        bottom_mlp=(64, 32),
+        top_mlp=(64, 1),
+        interaction="dot",
     )
+    params = init_params(jax.random.key(0), cfg)
+    tx = optax.sgd(1e-3)
+    opt_state = tx.init(params)
+    step = jax.jit(functools.partial(train_step, cfg=cfg, tx=tx), donate_argnums=(0, 1))
+
+    @jax.jit
+    def split(gb):
+        packed = gb["packed"]
+        return {
+            "label": packed[:, 0].astype(jnp.float32),
+            "dense": packed[:, 1:14].astype(jnp.float32),
+            "cat": packed[:, 14:40] % vocab,
+        }
+
+    it = ds.batches()  # phase 1 closed its iterator; epochs are infinite
+
+    def host_batches():
+        for cb in it:
+            yield host_batch_from_columnar(
+                cb, ds.schema, hash_buckets=hash_buckets, pack=pack
+            )
+
+    try:
+        dev_it = DeviceIterator(host_batches(), mesh)
+        duty = DutyCycle()
+        # warm THREE full iterations: the first call compiles, and the
+        # second can recompile (donated outputs come back device-resident
+        # with different layouts) — a compile leaking into the measured
+        # window would report compile time as device "busy" (observed: a
+        # 26s recompile turned the duty cycle into a meaningless 0.999)
+        for _ in range(3):
+            batch = split(next(dev_it))
+            params, opt_state, loss = step(params, opt_state, batch)
+            jax.block_until_ready(loss)
+        t_end = time.perf_counter() + seconds
+        while time.perf_counter() < t_end:
+            with duty.wait():
+                gb = next(dev_it)
+            with duty.step():
+                params, opt_state, loss = step(params, opt_state, split(gb))
+                jax.block_until_ready(loss)
+        return duty.value()
+    finally:
+        it.close()
 
 
 if __name__ == "__main__":

@@ -7,6 +7,7 @@ save modes, partitionBy layout, codec streams, and the streaming dataset
 reader, all on a non-local filesystem.
 """
 
+import os
 import uuid
 
 import numpy as np
@@ -127,6 +128,28 @@ def test_glob_memory(mem_url):
         tfio.write(ROWS[:4], SCHEMA, mem_url + f"/glob/{sub}", mode="overwrite")
     table = tfio.read(mem_url + "/glob/*", schema=SCHEMA)
     assert len(table.rows) == 8
+
+
+def test_walk_order_deterministic_memory(mem_url):
+    """Directory recursion must be sorted (fsspec's own walk follows ls/dict
+    order): every host must derive the SAME global shard order."""
+    for sub in ["b", "a", "c"]:  # insertion order != sorted order
+        tfio.write(ROWS[:2], SCHEMA, mem_url + f"/walk/{sub}", mode="overwrite")
+    fs = tfs.filesystem_for(mem_url)
+    seen = [p for p, _ in fs.walk_files(mem_url + "/walk", lambda n: not n.startswith("_"))]
+    assert seen == sorted(seen)
+    shards = tfio.discover_shards(mem_url + "/walk")
+    assert [s.path for s in shards] == sorted(s.path for s in shards)
+
+
+def test_local_walk_ignores_dir_symlink_cycles(tmp_path):
+    """A symlink cycle inside the dataset must not hang discovery, and a
+    symlink into the tree must not double-count shards (os.walk default)."""
+    out = str(tmp_path / "ds")
+    tfio.write([[1, 1.0, "a"]], SCHEMA, out, mode="overwrite")
+    os.symlink(out, os.path.join(out, "loop"))
+    shards = tfio.discover_shards(out)
+    assert len(shards) == 1
 
 
 def test_scheme_errors_cleanly(monkeypatch):

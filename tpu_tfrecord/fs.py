@@ -80,7 +80,9 @@ class LocalFS:
         """Deterministic (sorted) walk yielding (path, size) for files under
         root, descending only into directories ``keep`` accepts and yielding
         only files it accepts. Sizes come from the directory listing
-        (scandir stat) — no per-file stat round."""
+        (scandir stat) — no per-file stat round. Directory SYMLINKS are not
+        followed (os.walk's default): a link cycle must not hang discovery,
+        and a link into the same tree must not double-count shards."""
         stack = [root]
         while stack:
             dirpath = stack.pop()
@@ -89,8 +91,10 @@ class LocalFS:
                 for e in entries:
                     if not keep(e.name):
                         continue
-                    if e.is_dir(follow_symlinks=True):
+                    if e.is_dir(follow_symlinks=False):
                         dirs.append(e.path)
+                    elif e.is_dir(follow_symlinks=True):
+                        pass  # directory symlink: neither followed nor a file
                     else:
                         files.append((e.path, e.stat().st_size))
             for fpath, size in sorted(files):
@@ -178,27 +182,30 @@ class FsspecFS:
         )
 
     def walk_files(self, root: str, keep):
-        """(path, size) pairs; sizes come from walk's detail listing — one
-        list call per directory, not one HEAD per shard (thousands of serial
-        round-trips on object stores otherwise).
-
-        on_error="raise": a listing failure (transient 5xx, permissions)
-        must surface, not silently drop a subtree of shards — training on
-        partial data with no error is the worst outcome."""
-        for dirpath, dirs, files in self._fs.walk(
-            self._strip(root), detail=True, on_error="raise"
-        ):
-            # detail=True yields name->info dicts; prune by deleting keys
-            # (the walk recurses over what remains)
-            for d in [d for d in dirs if not keep(d)]:
-                del dirs[d]
-            for f in sorted(files):
-                if keep(f):
-                    info = files[f]
-                    yield (
-                        self._unstrip(dirpath.rstrip("/") + "/" + f),
-                        int(info.get("size") or 0),
-                    )
+        """(path, size) pairs via an explicit SORTED stack walk over
+        ``ls(detail=True)`` — one list call per directory, not one HEAD per
+        shard, and deterministic recursion order: fsspec's own walk recurses
+        in ls/dict order, which differs between hosts/backends and would
+        silently skew the global shard order every host must agree on.
+        Listing failures raise (a dropped subtree must never look like a
+        smaller dataset)."""
+        stack = [self._strip(root)]
+        while stack:
+            dirpath = stack.pop()
+            files, dirs = [], []
+            for info in self._fs.ls(dirpath, detail=True):
+                name = info["name"].rstrip("/")
+                if name == dirpath.rstrip("/"):
+                    continue  # some backends include the dir itself
+                if not keep(name.rsplit("/", 1)[-1]):
+                    continue
+                if info.get("type") == "directory":
+                    dirs.append(name)
+                else:
+                    files.append((name, int(info.get("size") or 0)))
+            for fpath, size in sorted(files):
+                yield self._unstrip(fpath), size
+            stack.extend(sorted(dirs, reverse=True))  # pop() visits in order
 
     def touch(self, path: str) -> None:
         self._fs.touch(self._strip(path))
