@@ -119,6 +119,104 @@ class TestMultiWorker:
             collect_uids(ds)
 
 
+class TestMmapPath:
+    def test_mmap_and_buffered_paths_agree(self, sandbox):
+        """Local uncompressed shards default to the mmap fast path; it must
+        be indistinguishable from the buffered path (order, values, resume
+        positions)."""
+        out = write_shards(sandbox, num_shards=3, rows_per_shard=11)
+        mm = TFRecordDataset(out, batch_size=7, schema=SCHEMA, drop_remainder=False)
+        buf = TFRecordDataset(
+            out, batch_size=7, schema=SCHEMA, drop_remainder=False, use_mmap=False
+        )
+        assert collect_uids(mm) == collect_uids(buf)
+        # mid-stream state from one path resumes identically on the other
+        it = mm.batches()
+        next(it)
+        st = it.state()
+        it.close()
+        assert collect_uids(
+            TFRecordDataset(
+                out, batch_size=7, schema=SCHEMA, drop_remainder=False, use_mmap=False
+            ),
+            st,
+        ) == collect_uids(
+            TFRecordDataset(out, batch_size=7, schema=SCHEMA, drop_remainder=False),
+            st,
+        )
+
+    def test_mmap_transient_open_error_retried(self, sandbox, monkeypatch):
+        """The mmap path opens files via its own seam (_open_local);
+        a transient OSError there must be retried like the buffered path."""
+        out = write_shards(sandbox, num_shards=1)
+        calls = {"n": 0}
+        import tpu_tfrecord.io.dataset as dsmod
+
+        real_open = dsmod._open_local
+
+        def flaky(path, mode):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("transient blip")
+            return real_open(path, mode)
+
+        monkeypatch.setattr(dsmod, "_open_local", flaky)
+        ds = TFRecordDataset(out, batch_size=7, schema=SCHEMA, read_retries=2)
+        assert len(collect_uids(ds)) == 7
+        assert calls["n"] == 2
+
+    def test_mmap_mid_shard_retry_no_duplicates(self, sandbox, monkeypatch):
+        """Corruption past the first chunk: the retry must resume after the
+        records already emitted — no duplicates, no holes (mmap path)."""
+        out = write_shards(sandbox, num_shards=1, rows_per_shard=3000)
+        f = [os.path.join(out, x) for x in os.listdir(out) if x.endswith(".tfrecord")][0]
+        good = open(f, "rb").read()
+        bad = bytearray(good)
+        bad[-10] ^= 0x55  # corrupt the LAST record (second decode chunk)
+        open(f, "wb").write(bytes(bad))
+
+        def repair(_seconds):
+            open(f, "wb").write(good)
+
+        monkeypatch.setattr("tpu_tfrecord.io.dataset.time.sleep", repair)
+        ds = TFRecordDataset(
+            out, batch_size=2048, schema=SCHEMA, read_retries=2, drop_remainder=False
+        )
+        uids = collect_uids(ds)
+        assert uids == list(range(3000))  # exactly once each, in order
+
+    def test_mmap_bogus_length_within_file_raises(self, sandbox):
+        """verify_crc=False + a corrupt length field whose bogus value still
+        FITS in the remaining file: must raise max_record_bytes corruption,
+        never swallow the remaining records as one giant 'record'."""
+        import struct
+
+        from tpu_tfrecord import wire
+
+        out = write_shards(sandbox, num_shards=1, rows_per_shard=200)
+        f = [os.path.join(out, x) for x in os.listdir(out) if x.endswith(".tfrecord")][0]
+        raw = bytearray(open(f, "rb").read())
+        struct.pack_into("<Q", raw, 0, len(raw) // 2)  # bogus but in-bounds
+        open(f, "wb").write(bytes(raw))
+        ds = TFRecordDataset(
+            out, batch_size=10, schema=SCHEMA, verify_crc=False,
+            max_record_bytes=1024,
+        )
+        with pytest.raises(wire.TFRecordCorruptionError, match="max_record_bytes"):
+            collect_uids(ds)
+
+    def test_mmap_truncated_shard_raises(self, sandbox):
+        out = write_shards(sandbox, num_shards=1, rows_per_shard=20)
+        f = [os.path.join(out, x) for x in os.listdir(out) if x.endswith(".tfrecord")][0]
+        blob = open(f, "rb").read()
+        open(f, "wb").write(blob[: len(blob) - 7])
+        from tpu_tfrecord import wire
+
+        ds = TFRecordDataset(out, batch_size=4, schema=SCHEMA)
+        with pytest.raises(wire.TFRecordCorruptionError, match="truncated"):
+            collect_uids(ds)
+
+
 class TestShuffle:
     def test_shuffle_is_permutation_and_seeded(self, sandbox):
         out = write_shards(sandbox)
@@ -179,8 +277,9 @@ class TestShuffle:
 class TestRetries:
     def test_transient_io_error_retried(self, sandbox, monkeypatch):
         out = write_shards(sandbox, num_shards=1)
+        # use_mmap=False: stream-level fault injection targets the buffered path
         ds = TFRecordDataset(out, batch_size=7, schema=SCHEMA, read_retries=2,
-                             drop_remainder=False)
+                             drop_remainder=False, use_mmap=False)
         real_open = __import__("tpu_tfrecord.wire", fromlist=["wire"]).open_compressed
         calls = {"n": 0}
 
@@ -197,7 +296,8 @@ class TestRetries:
 
     def test_exhausted_retries_raise(self, sandbox, monkeypatch):
         out = write_shards(sandbox, num_shards=1)
-        ds = TFRecordDataset(out, batch_size=7, schema=SCHEMA, read_retries=1)
+        ds = TFRecordDataset(out, batch_size=7, schema=SCHEMA, read_retries=1,
+                             use_mmap=False)
 
         def always_fail(path, mode, codec):
             raise OSError("gone")
@@ -361,8 +461,10 @@ class TestSlabStreaming:
             return FlakyFile(real_open(path, mode, codec))
 
         monkeypatch.setattr("tpu_tfrecord.wire.open_compressed", flaky)
+        # use_mmap=False: stream-level fault injection targets the buffered
+        # path (the mmap fast path opens files directly; see use_mmap doc)
         ds = TFRecordDataset(out, batch_size=10, schema=SCHEMA, slab_bytes=200,
-                             read_retries=2, drop_remainder=False)
+                             read_retries=2, drop_remainder=False, use_mmap=False)
         uids = collect_uids(ds)
         assert uids == list(range(60))
         assert state["opens"] >= 2  # retried

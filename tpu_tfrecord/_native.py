@@ -98,7 +98,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.tfr_scan_decode.restype = ctypes.c_void_p
     lib.tfr_scan_decode.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
-        ctypes.c_int32, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64,
         ctypes.c_int32, ctypes.c_int32, ctypes.POINTER(ctypes.c_char_p),
         i32p, i32p, i32p, u8p, i64p,
         i32p, i64p, ctypes.c_int32, i64p,
@@ -449,6 +449,7 @@ class NativeDecoder:
         skip_records: int,
         max_records: int,
         length: Optional[int] = None,
+        max_record_bytes: int = 0,
     ) -> Tuple[Optional[ColumnarBatch], int, int, int]:
         """Fused frame scan + decode in ONE pass over ``buf`` from ``start``:
         CRC-verify and skip ``skip_records`` frames (resume), then decode up
@@ -480,6 +481,7 @@ class NativeDecoder:
             1 if verify_crc else 0,
             skip_records,
             max_records,
+            max_record_bytes,
             self._fmt,
             len(self.schema),
             self._c_names,

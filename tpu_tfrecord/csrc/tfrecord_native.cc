@@ -1250,6 +1250,7 @@ void* tfr_decode_batch(const uint8_t* buf,
 // "corrupt TFRecord"/"truncated TFRecord" = framing, else decode error).
 void* tfr_scan_decode(const uint8_t* buf, uint64_t len, uint64_t start,
                       int32_t verify, int64_t skip_records, int64_t max_records,
+                      uint64_t max_record_bytes,
                       int32_t record_format,
                       int32_t n_fields, const char** field_names,
                       const int32_t* layouts, const int32_t* kinds,
@@ -1271,6 +1272,17 @@ void* tfr_scan_decode(const uint8_t* buf, uint64_t len, uint64_t start,
     if (pos + 12 > len) break;  // incomplete header -> tail
     uint64_t rec_len;
     std::memcpy(&rec_len, buf + pos, 8);
+    if (max_record_bytes && rec_len > max_record_bytes) {
+      // a corrupt length field (possible with verify off) must never
+      // swallow the rest of the shard as one giant "record"
+      std::snprintf(errbuf, errbuf_len,
+                    "corrupt TFRecord: record length %llu exceeds "
+                    "max_record_bytes (%llu)",
+                    (unsigned long long)rec_len,
+                    (unsigned long long)max_record_bytes);
+      delete st.res;
+      return nullptr;
+    }
     uint32_t len_crc;
     std::memcpy(&len_crc, buf + pos + 8, 4);
     if (verify && masked_crc(buf + pos, 8) != len_crc) {

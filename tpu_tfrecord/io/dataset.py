@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import queue
 import threading
 import time
@@ -40,6 +41,11 @@ from tpu_tfrecord.io.reader import DatasetReader
 from tpu_tfrecord.metrics import METRICS, timed
 from tpu_tfrecord.options import TFRecordOptions
 from tpu_tfrecord.schema import StructType
+
+
+# Injectable opener for the mmap fast path (it bypasses wire.open_compressed,
+# so fault-injection tests patch THIS seam).
+_open_local = open
 
 
 @dataclass(frozen=True)
@@ -103,6 +109,7 @@ class TFRecordDataset:
         pack: Optional[Dict[str, List[str]]] = None,
         slab_bytes: int = 256 << 20,
         max_record_bytes: int = 1 << 30,
+        use_mmap: bool = True,
         **option_kwargs: Any,
     ):
         self._reader = (
@@ -153,6 +160,11 @@ class TFRecordDataset:
         self.read_retries = read_retries
         self.slab_bytes = max(1, slab_bytes)
         self.max_record_bytes = max_record_bytes
+        # mmap fast path for LOCAL uncompressed shards: decode reads the
+        # page cache directly (no read() copy pass). Tradeoff: an async
+        # disk/NFS error surfaces as SIGBUS instead of a retryable OSError —
+        # set use_mmap=False on unreliable mounts to keep stream semantics.
+        self.use_mmap = use_mmap
 
     # -- chunked decode stream with positional accounting --------------------
     #
@@ -170,6 +182,19 @@ class TFRecordDataset:
         ]
         return self._decoder.decode_batch(records)
 
+    def _truncated_error(self, path: str) -> "wire.TFRecordCorruptionError":
+        return wire.TFRecordCorruptionError(f"truncated TFRecord at end of {path}")
+
+    def _check_declared_length(self, declared: int, path: str) -> None:
+        """One owner for the corrupt-length contract (possible with
+        verify_crc=False): an absurd declared length must raise promptly,
+        never buffer or swallow the rest of a shard."""
+        if declared > self.max_record_bytes:
+            raise wire.TFRecordCorruptionError(
+                f"record length {declared} exceeds max_record_bytes "
+                f"({self.max_record_bytes}) in {path} — corrupt length field?"
+            )
+
     def _read_slab(self, fh, tail: bytes, path: str) -> Optional[bytes]:
         """Read the next slab, honoring the bounded tail-carry contract:
         once a partial frame header is visible, the declared record length
@@ -182,18 +207,12 @@ class TFRecordDataset:
         want = self.slab_bytes
         if len(tail) >= 8:
             declared = int.from_bytes(tail[:8], "little")
-            if declared > self.max_record_bytes:
-                raise wire.TFRecordCorruptionError(
-                    f"record length {declared} exceeds max_record_bytes "
-                    f"({self.max_record_bytes}) in {path} — corrupt length field?"
-                )
+            self._check_declared_length(declared, path)
             want = max(want, 16 + declared - len(tail))
         data = fh.read(want)
         if not data:
             if tail:
-                raise wire.TFRecordCorruptionError(
-                    f"truncated TFRecord at end of {path}"
-                )
+                raise self._truncated_error(path)
             return None
         return tail + data if tail else data
 
@@ -319,11 +338,7 @@ class TFRecordDataset:
         buf = scratch["buf"]
         if tail_len >= 8:
             declared = int(buf[:8].view(np.uint64)[0])
-            if declared > self.max_record_bytes:
-                raise wire.TFRecordCorruptionError(
-                    f"record length {declared} exceeds max_record_bytes "
-                    f"({self.max_record_bytes}) in {path} — corrupt length field?"
-                )
+            self._check_declared_length(declared, path)
             needed = 16 + declared
             if needed > buf.nbytes:
                 grown = np.empty(int(needed), np.uint8)
@@ -340,11 +355,78 @@ class TFRecordDataset:
             buf[tail_len : tail_len + n] = np.frombuffer(data, np.uint8)
         if not n:
             if tail_len:
-                raise wire.TFRecordCorruptionError(
-                    f"truncated TFRecord at end of {path}"
-                )
+                raise self._truncated_error(path)
             return -1
         return tail_len + n
+
+    def _decode_shard_mmap(
+        self, epoch: int, pos: int, shard_idx: int, skip: int
+    ) -> Iterator[tuple]:
+        """Local uncompressed shards: mmap the file and scan+decode straight
+        out of the page cache — no read() copy pass at all. Slab bounds are
+        irrelevant (nothing is materialized; the kernel evicts clean pages
+        freely); chunk positions and retry semantics match the buffered
+        path."""
+        import mmap
+
+        from tpu_tfrecord.tracing import trace
+
+        chunk_records = max(self.batch_size, 2048)
+        next_index = skip
+        attempt = 0
+        dec = self._native_decoder
+        verify = self.options.verify_crc
+        shard = self.shards[shard_idx]
+        while True:
+            try:
+                with _open_local(shard.path, "rb") as fh:
+                    size = os.fstat(fh.fileno()).st_size
+                    if size == 0:
+                        return
+                    mm = mmap.mmap(fh.fileno(), 0, prot=mmap.PROT_READ)
+                    try:
+                        buf = np.frombuffer(mm, np.uint8)
+                        to_skip = next_index
+                        abs_idx = 0
+                        bpos = 0
+                        while True:
+                            with timed("decode", METRICS) as t, trace("tfr:decode"):
+                                cb, n_sk, n_done, consumed = dec.scan_decode(
+                                    buf, bpos, verify, to_skip, chunk_records,
+                                    length=size,
+                                    max_record_bytes=self.max_record_bytes,
+                                )
+                                t.records += n_done
+                                t.bytes += consumed - bpos
+                            to_skip -= n_sk
+                            abs_idx += n_sk
+                            bpos = consumed
+                            if n_done == 0:
+                                if bpos != size:
+                                    # an oversized declared length raised
+                                    # inside scan_decode; what remains here
+                                    # is a genuine partial tail frame
+                                    raise self._truncated_error(shard.path)
+                                return
+                            if self._partition_fields:
+                                self._attach_partition_chunk(cb, shard_idx)
+                            yield cb, epoch, pos, abs_idx
+                            abs_idx += n_done
+                            next_index = abs_idx
+                    finally:
+                        # the numpy view exports mm's buffer: drop it before
+                        # closing, else BufferError; if anything else still
+                        # holds the view, GC closes the map later
+                        try:
+                            del buf
+                            mm.close()
+                        except (BufferError, UnboundLocalError):
+                            pass
+            except (OSError, wire.TFRecordCorruptionError):
+                attempt += 1
+                if attempt > self.read_retries:
+                    raise
+                time.sleep(min(0.1 * 2**attempt, 2.0))
 
     def _decode_shard_fused(
         self, epoch: int, pos: int, shard_idx: int, skip: int
@@ -355,15 +437,19 @@ class TFRecordDataset:
         a reused per-thread buffer (readinto, no per-slab allocations). Same
         chunk positions, retry semantics, and bounded tail-carry contract as
         the two-pass path."""
+        from tpu_tfrecord import fs as _fs
         from tpu_tfrecord.tracing import trace
 
+        shard = self.shards[shard_idx]
+        codec = wire.codec_from_path(shard.path)
+        if self.use_mmap and codec is None and not _fs.has_scheme(shard.path):
+            yield from self._decode_shard_mmap(epoch, pos, shard_idx, skip)
+            return
         chunk_records = max(self.batch_size, 2048)
         next_index = skip  # record index within the shard to emit next
         attempt = 0
         dec = self._native_decoder
         verify = self.options.verify_crc
-        shard = self.shards[shard_idx]
-        codec = wire.codec_from_path(shard.path)
         scratch = self._io_scratch()
         while True:
             try:
@@ -388,6 +474,7 @@ class TFRecordDataset:
                                 cb, n_sk, n_done, consumed = dec.scan_decode(
                                     buf, bpos, verify, to_skip, chunk_records,
                                     length=data_len,
+                                    max_record_bytes=self.max_record_bytes,
                                 )
                                 t.records += n_done
                                 t.bytes += consumed - bpos
