@@ -119,6 +119,13 @@ class TestMultiWorker:
             collect_uids(ds)
 
 
+from tpu_tfrecord import _native as _native_mod
+
+
+@pytest.mark.skipif(
+    not _native_mod.available(),
+    reason="mmap fast path requires the native fused decoder",
+)
 class TestMmapPath:
     def test_mmap_and_buffered_paths_agree(self, sandbox):
         """Local uncompressed shards default to the mmap fast path; it must
