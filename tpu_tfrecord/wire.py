@@ -131,9 +131,22 @@ _CODEC_ALIASES = {
     "deflate": "deflate",
     "zlib": "deflate",
     "org.apache.hadoop.io.compress.defaultcodec": "deflate",
+    "zstd": "zstd",
+    "zstandard": "zstd",
+    "org.apache.hadoop.io.compress.zstandardcodec": "zstd",
 }
 
-_CODEC_EXTENSIONS = {"gzip": ".gz", "deflate": ".deflate"}
+_CODEC_EXTENSIONS = {"gzip": ".gz", "deflate": ".deflate", "zstd": ".zst"}
+
+
+def _zstandard():
+    """The optional zstandard module, or None (zstd support is gated)."""
+    try:
+        import zstandard
+
+        return zstandard
+    except ImportError:
+        return None
 
 
 def normalize_codec(codec: Optional[str]) -> Optional[str]:
@@ -142,10 +155,15 @@ def normalize_codec(codec: Optional[str]) -> Optional[str]:
         return None
     key = codec.strip().lower()
     if key in _CODEC_ALIASES:
-        return _CODEC_ALIASES[key]
+        resolved = _CODEC_ALIASES[key]
+        if resolved == "zstd" and _zstandard() is None:
+            raise ValueError(
+                f"codec {codec!r} requires the optional 'zstandard' package"
+            )
+        return resolved
     raise ValueError(
-        f"Unsupported codec {codec!r}: supported codecs are 'gzip' and "
-        "'deflate' (or their Hadoop class names)"
+        f"Unsupported codec {codec!r}: supported codecs are 'gzip', "
+        "'deflate', and 'zstd' (or their Hadoop class names)"
     )
 
 
@@ -162,6 +180,8 @@ def codec_from_path(path: str) -> Optional[str]:
         return "gzip"
     if lower.endswith(".deflate") or lower.endswith(".zlib"):
         return "deflate"
+    if lower.endswith(".zst") or lower.endswith(".zstd"):
+        return "zstd"
     return None
 
 
@@ -184,7 +204,103 @@ def open_compressed(path: str, mode: str, codec: Optional[str]) -> BinaryIO:
         return _ClosingGzip(raw, mode)  # type: ignore[return-value]
     if codec == "deflate":
         return _DeflateFile(path, mode, fileobj=raw)
+    if codec == "zstd":
+        return _ZstdFile(path, mode, fileobj=raw)
     return raw
+
+
+class _ZstdFile(io.RawIOBase):
+    """zstd-framed stream (Hadoop ZStandardCodec / .zst files), backed by
+    the optional ``zstandard`` package. Reads stream incrementally through
+    ``decompressobj`` and CHECK frame completion at EOF via its ``eof``
+    flag — ``stream_reader`` returns a clean short read on a truncated
+    frame, which would silently drop trailing records (the same trap
+    _DeflateFile guards with zlib's eof). Concatenated frames are handled.
+    Writes flush the frame on close and close the underlying stream
+    (remote writers upload on close)."""
+
+    _READ_CHUNK = 1 << 20  # compressed bytes per underlying read
+
+    def __init__(self, path: str, mode: str, fileobj: Optional[BinaryIO] = None):
+        super().__init__()
+        zstd = _zstandard()
+        if zstd is None:  # normalize_codec guards, but be safe
+            raise ValueError("zstd codec requires the optional 'zstandard' package")
+        self._zstd = zstd
+        self._path = path
+        if "w" in mode:
+            self._raw = fileobj if fileobj is not None else open(path, "wb")
+            self._writer = zstd.ZstdCompressor().stream_writer(
+                self._raw, closefd=False
+            )
+            self._dobj = None
+        else:
+            self._raw = fileobj if fileobj is not None else open(path, "rb")
+            self._writer = None
+            self._dobj = zstd.ZstdDecompressor().decompressobj()
+            self._pending = bytearray()
+            self._eof = False
+
+    def readable(self) -> bool:
+        return self._dobj is not None
+
+    def writable(self) -> bool:
+        return self._writer is not None
+
+    def _fill(self) -> None:
+        raw = self._raw.read(self._READ_CHUNK)
+        if not raw:
+            if not self._dobj.eof:
+                raise TFRecordCorruptionError(
+                    f"truncated zstd stream in {self._path}"
+                )
+            self._eof = True
+            return
+        try:
+            while raw:
+                self._pending += self._dobj.decompress(raw)
+                if self._dobj.eof:
+                    # concatenated frames: restart on the leftover input
+                    raw = self._dobj.unused_data
+                    if raw:
+                        self._dobj = self._zstd.ZstdDecompressor().decompressobj()
+                        continue
+                break
+        except self._zstd.ZstdError as e:
+            raise TFRecordCorruptionError(
+                f"corrupt zstd stream in {self._path}: {e}"
+            ) from e
+
+    def read(self, size: int = -1) -> bytes:
+        if size is None or size < 0:
+            while not self._eof:
+                self._fill()
+            out = bytes(self._pending)
+            self._pending = bytearray()
+            return out
+        while len(self._pending) < size and not self._eof:
+            self._fill()
+        out = bytes(self._pending[:size])
+        del self._pending[:size]
+        return out
+
+    def readinto(self, b) -> int:
+        data = self.read(len(b))
+        b[: len(data)] = data
+        return len(data)
+
+    def write(self, data) -> int:
+        return self._writer.write(data)
+
+    def close(self) -> None:
+        if not self.closed:
+            try:
+                if self._writer is not None:
+                    self._writer.close()  # flushes the frame
+            finally:
+                if not self._raw.closed:
+                    self._raw.close()
+                super().close()
 
 
 class _ClosingGzip(gzip.GzipFile):
