@@ -1,13 +1,25 @@
 """Reference consumers of the ingestion pipeline.
 
 The reference framework ships no models (SURVEY.md §2: model-side parallelism
-N/A) — its output is consumed by TensorFlow training jobs. Here the flagship
-consumer is in-tree: a Criteo-style DLRM (the BASELINE.md north-star workload
-is Criteo-1TB ingest) whose training step exercises every mesh axis the
-ingest layer produces: batch on 'data' (DP), embedding tables and hidden
-layers on 'model' (TP), padded sequence features on 'seq' (SP).
+N/A) — its output is consumed by TensorFlow training jobs. Here two model
+families are in-tree:
+
+- ``dlrm``: a Criteo-style DLRM (the BASELINE.md north-star workload is
+  Criteo-1TB ingest) whose training step exercises batch on 'data' (DP),
+  embedding tables and hidden layers on 'model' (TP), and padded sequence
+  features on 'seq' (SP).
+- ``long_doc``: a transformer-style long-document classifier whose
+  attention runs as ring attention over the 'seq' axis — the long-context
+  consumer of SequenceExample ingestion (``frames``/``frames_len``).
+
+The package-level flat names (init_params/forward/train_step/...) are the
+DLRM family's, kept for compatibility; each family's full API lives on its
+module (``models.dlrm``, ``models.long_doc``) — use those when working
+with a specific family, the function names intentionally mirror each
+other.
 """
 
+from tpu_tfrecord.models import dlrm, long_doc
 from tpu_tfrecord.models.dlrm import (
     DLRMConfig,
     forward,
@@ -19,6 +31,8 @@ from tpu_tfrecord.models.dlrm import (
 )
 
 __all__ = [
+    "dlrm",
+    "long_doc",
     "DLRMConfig",
     "init_params",
     "forward",

@@ -46,8 +46,10 @@ class DLRMConfig:
     interaction: str = "cat"
 
 
-def _dense_init(rng, fan_in: int, fan_out: int):
-    scale = np.sqrt(2.0 / fan_in)
+def _dense_init(rng, fan_in: int, fan_out: int, gain: float = 2.0):
+    """He-style dense init ({'w','b'} dict); shared by the model families
+    (gain=2 for relu stacks, 1 for pre-norm residual blocks)."""
+    scale = np.sqrt(gain / fan_in)
     w = jax.random.normal(rng, (fan_in, fan_out), jnp.float32) * scale
     return {"w": w, "b": jnp.zeros((fan_out,), jnp.float32)}
 

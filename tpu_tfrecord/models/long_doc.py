@@ -1,0 +1,176 @@
+"""Long-document classifier: the long-context consumer of the ingest layer.
+
+The second model family next to DLRM (models/dlrm.py): where DLRM exercises
+dp x tp over tabular Examples, this transformer-style encoder exercises
+dp x SP over SequenceExamples — the padded ``frames`` [B, L, D] +
+``frames_len`` [B] arrays that `tpu_tfrecord.tpu.ingest` produces from
+ragged FeatureLists feed straight into ring attention
+(models/attention.py) with the sequence dim sharded on the mesh 'seq'
+axis: no device ever holds more than its L/P chunk of K/V, K/V blocks
+rotate over ICI, and padded positions are masked exactly via the lengths
+the decoder emitted.
+
+TPU shaping: all compute is batched matmuls (MXU) in bfloat16 with float32
+accumulation; the train step is one jit (loss -> grad -> optax update,
+donated state); no data-dependent Python control flow.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_tfrecord.models.attention import attention_reference, ring_attention
+from tpu_tfrecord.models.dlrm import (
+    _dense_init as _dlrm_dense_init,
+    batch_shardings as _dlrm_batch_shardings,
+)
+
+
+@dataclass(frozen=True)
+class LongDocConfig:
+    seq_dim: int = 16        # input frame feature dim (ingest output)
+    d_model: int = 32
+    n_heads: int = 4
+    n_layers: int = 2
+    mlp_mult: int = 4
+    n_classes: int = 2
+    max_len: int = 128       # padded sequence length (pad_to of the ingest)
+    dtype: Any = jnp.bfloat16
+
+
+def _dense_init(rng, fan_in: int, fan_out: int):
+    # gain=1: pre-norm residual blocks want unit-variance projections
+    return _dlrm_dense_init(rng, fan_in, fan_out, gain=1.0)
+
+
+def init_params(rng: jax.Array, cfg: LongDocConfig) -> Dict[str, Any]:
+    if cfg.d_model % cfg.n_heads:
+        raise ValueError(
+            f"n_heads ({cfg.n_heads}) must divide d_model ({cfg.d_model}) evenly"
+        )
+    keys = jax.random.split(rng, 4 + cfg.n_layers)
+    params: Dict[str, Any] = {
+        "embed": _dense_init(keys[0], cfg.seq_dim, cfg.d_model),
+        # learned positions: [max_len, d_model]
+        "pos": jax.random.normal(keys[1], (cfg.max_len, cfg.d_model), jnp.float32)
+        * 0.02,
+        "head": _dense_init(keys[2], cfg.d_model, cfg.n_classes),
+    }
+    layers = []
+    for i in range(cfg.n_layers):
+        k = jax.random.split(keys[3 + i], 4)
+        layers.append(
+            {
+                "qkv": _dense_init(k[0], cfg.d_model, 3 * cfg.d_model),
+                "proj": _dense_init(k[1], cfg.d_model, cfg.d_model),
+                "mlp_in": _dense_init(k[2], cfg.d_model, cfg.mlp_mult * cfg.d_model),
+                "mlp_out": _dense_init(k[3], cfg.mlp_mult * cfg.d_model, cfg.d_model),
+            }
+        )
+    params["layers"] = layers
+    return params
+
+
+def _dense(layer, x, dt):
+    return x @ layer["w"].astype(dt) + layer["b"].astype(dt)
+
+
+def _rms_norm(x):
+    scale = jax.lax.rsqrt(
+        jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True) + 1e-6
+    )
+    return (x.astype(jnp.float32) * scale).astype(x.dtype)
+
+
+def forward(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    cfg: LongDocConfig,
+    mesh: Optional[Mesh] = None,
+    seq_axis: str = "seq",
+    data_axis: Optional[str] = None,
+) -> jax.Array:
+    """Logits [B, n_classes]. With ``mesh``, attention runs as ring
+    attention over ``seq_axis`` (SP); without, the dense reference — the
+    two are numerically equivalent (pinned by tests)."""
+    dt = cfg.dtype
+    frames = batch["frames"].astype(dt)                    # [B, L, Din]
+    lengths = batch["frames_len"]
+    b, l, _ = frames.shape
+    h = cfg.n_heads
+    dh = cfg.d_model // h
+    x = _dense(params["embed"], frames, dt) + params["pos"][:l].astype(dt)[None]
+    for layer in params["layers"]:
+        qkv = _dense(layer["qkv"], _rms_norm(x), dt)        # [B, L, 3*D]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, l, h, dh)
+        k = k.reshape(b, l, h, dh)
+        v = v.reshape(b, l, h, dh)
+        if mesh is not None:
+            att = ring_attention(
+                q, k, v, mesh, seq_axis=seq_axis, data_axis=data_axis,
+                lengths=lengths,
+            )
+        else:
+            att = attention_reference(q, k, v, lengths=lengths)
+        x = x + _dense(layer["proj"], att.reshape(b, l, cfg.d_model), dt)
+        y = _dense(layer["mlp_in"], _rms_norm(x), dt)
+        x = x + _dense(layer["mlp_out"], jax.nn.gelu(y), dt)
+    # masked mean pool over the valid prefix
+    mask = (jnp.arange(l)[None, :] < lengths[:, None]).astype(jnp.float32)
+    pooled = (x.astype(jnp.float32) * mask[:, :, None]).sum(axis=1) / jnp.maximum(
+        mask.sum(axis=1, keepdims=True), 1.0
+    )
+    return _dense(params["head"], pooled.astype(dt), dt).astype(jnp.float32)
+
+
+def loss_fn(params, batch, cfg: LongDocConfig, mesh=None, seq_axis="seq",
+            data_axis=None) -> jax.Array:
+    logits = forward(params, batch, cfg, mesh, seq_axis, data_axis)
+    labels = batch["label"].astype(jnp.int32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+
+def train_step(params, opt_state, batch, cfg: LongDocConfig, tx, mesh=None,
+               seq_axis="seq", data_axis=None):
+    """One optimizer step; jit this whole function (mesh static via closure)."""
+    loss, grads = jax.value_and_grad(loss_fn)(
+        params, batch, cfg, mesh, seq_axis, data_axis
+    )
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = jax.tree.map(lambda p, u: p + u, params, updates)
+    return params, opt_state, loss
+
+
+def batch_shardings(mesh: Mesh, batch, data_axis: str = "data",
+                    seq_axis: Optional[str] = "seq"):
+    """Same policy as dlrm.batch_shardings ('frames' on (data, seq), batch
+    dim everywhere else), with SP on by default for this family."""
+    return _dlrm_batch_shardings(mesh, batch, data_axis=data_axis, seq_axis=seq_axis)
+
+
+def make_synthetic_batch(cfg: LongDocConfig, batch_size: int, seed: int = 0):
+    """Host batch in the ingest layer's layout (frames/frames_len/label).
+    Labels correlate with the frames so training has signal."""
+    rng = np.random.default_rng(seed)
+    frames = rng.normal(size=(batch_size, cfg.max_len, cfg.seq_dim)).astype(
+        np.float32
+    )
+    lengths = rng.integers(1, cfg.max_len + 1, size=(batch_size,)).astype(np.int32)
+    mask = np.arange(cfg.max_len)[None, :] < lengths[:, None]
+    mean0 = (frames[:, :, 0] * mask).sum(axis=1) / np.maximum(mask.sum(axis=1), 1)
+    label = (mean0 > 0).astype(np.int32) % cfg.n_classes
+    return {"frames": frames, "frames_len": lengths, "label": label}
+
+
+def param_shardings(mesh: Mesh, params):
+    """Replicated parameters (the model is small; SP shards activations)."""
+    return jax.tree.map(lambda _: NamedSharding(mesh, P()), params)
