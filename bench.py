@@ -135,7 +135,14 @@ def main() -> None:
     # (block on the already-dispatched transfer), "wait" = host work to
     # produce the next batch. With full overlap the block is ~all of the
     # loop, mirroring a training loop whose step hides the input pipeline.
+    # This is a SHARED box: other tenants' load swings any single window by
+    # +-25%. Measure N windows back-to-back within one run and report the
+    # MEDIAN (the standard interference-robust estimator); every window is
+    # disclosed in the output.
+    n_windows = max(1, int(os.environ.get("TFR_BENCH_WINDOWS", 3)))
+    window_seconds = MEASURE_SECONDS / n_windows
     duty = DutyCycle()
+    windows = []
     examples = 0
     measuring = False
     t_start = t_end = 0.0
@@ -156,14 +163,19 @@ def main() -> None:
             elif measuring:
                 examples += BATCH_SIZE
                 t_end = now
-                if t_end - t_start >= MEASURE_SECONDS:
-                    break
+                if t_end - t_start >= window_seconds:
+                    windows.append(examples / (t_end - t_start))
+                    examples = 0
+                    t_start = t_end
+                    if len(windows) >= n_windows:
+                        break
             i += 1
     finally:
         it.close()
 
-    elapsed = max(t_end - t_start, 1e-9)
-    value = examples / elapsed
+    import statistics
+
+    value = statistics.median(windows)
 
     # Phase 2 — the BASELINE.md duty-cycle metric measured the way it is
     # defined: a real DLRM training step on the device consuming ingested
@@ -179,6 +191,8 @@ def main() -> None:
         "value": round(value, 1),
         "unit": "examples/sec/host",
         "vs_baseline": round(value / 1_000_000, 4),
+        # all measurement windows (median is the reported value)
+        "windows": [round(w, 1) for w in windows],
         # transfer-hidden fraction of the ingest-only loop (phase 1)
         "ingest_duty_cycle": round(duty.value() or 0.0, 4),
     }

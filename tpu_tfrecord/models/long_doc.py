@@ -17,9 +17,8 @@ donated state); no data-dependent Python control flow.
 
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -55,7 +54,7 @@ def init_params(rng: jax.Array, cfg: LongDocConfig) -> Dict[str, Any]:
         raise ValueError(
             f"n_heads ({cfg.n_heads}) must divide d_model ({cfg.d_model}) evenly"
         )
-    keys = jax.random.split(rng, 4 + cfg.n_layers)
+    keys = jax.random.split(rng, 3 + cfg.n_layers)
     params: Dict[str, Any] = {
         "embed": _dense_init(keys[0], cfg.seq_dim, cfg.d_model),
         # learned positions: [max_len, d_model]
