@@ -91,11 +91,32 @@ def ensure_dataset(data_dir: str) -> str:
 
 
 def main() -> None:
+    import threading
+
     import jax
 
     from tpu_tfrecord.io.dataset import TFRecordDataset
     from tpu_tfrecord.tpu import DeviceIterator, create_mesh, host_batch_from_columnar
     from tpu_tfrecord.tracing import DutyCycle
+
+    # Backend-init watchdog: a dead TPU tunnel makes jax.devices() block
+    # forever inside C (observed on this box) — fail loudly with a
+    # diagnosable message instead of hanging the harness.
+    backend_up = threading.Event()
+
+    def _watchdog():
+        if not backend_up.wait(float(os.environ.get("TFR_BENCH_INIT_TIMEOUT", 300))):
+            print(
+                json.dumps(
+                    {
+                        "metric": "criteo_tf_example_ingest_to_device",
+                        "error": "TPU backend initialization timed out "
+                        "(device tunnel unreachable?) — no measurement taken",
+                    }
+                ),
+                flush=True,
+            )
+            os._exit(3)
 
     data_dir = os.environ.get("TFR_BENCH_DIR", "/tmp/tpu_tfrecord_bench_v2")
     ensure_dataset(data_dir)
@@ -109,7 +130,11 @@ def main() -> None:
         + [f"I{i}" for i in range(1, 14)]
         + [f"C{i}" for i in range(1, 27)],
     }
+    # Arm only around backend init — dataset generation above must not
+    # count against the tunnel timeout.
+    threading.Thread(target=_watchdog, daemon=True).start()
     mesh = create_mesh()  # all available devices on the 'data' axis
+    backend_up.set()
     ds = TFRecordDataset(
         data_dir,
         batch_size=BATCH_SIZE,
