@@ -217,6 +217,51 @@ inline bool read_varint(Cursor& c, uint64_t* out) {
   return false;
 }
 
+inline bool turbo_read_varint(const uint8_t*& p, const uint8_t* end, uint64_t* out) {
+  if (p < end && !(*p & 0x80)) { *out = *p++; return true; }  // 1-byte fast case
+  uint64_t result = 0;
+  int shift = 0;
+  while (p < end) {
+    uint8_t b = *p++;
+    result |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) { *out = result; return true; }
+    shift += 7;
+    if (shift > 63) return false;
+  }
+  return false;
+}
+
+// Branch-light varint decode: load 8 bytes, locate the terminator byte with
+// ctz over the inverted continuation bits, extract the payload bits with
+// PEXT. Covers varints up to 8 bytes (56 bits — every int32-range feature);
+// longer ones and buffer tails fall back to the byte loop. Compiled with a
+// per-function target attribute and dispatched at runtime so the library
+// never executes PEXT on a CPU without BMI2 (and the binary itself is not
+// built -mbmi2). Note: PEXT is microcoded (slow) on AMD Zen1/Zen2; the
+// expected deployment (TPU host VMs) is Intel, where it is 3 cycles.
+#if defined(__x86_64__)
+__attribute__((target("bmi2"), noinline))
+bool turbo_varint_pext(const uint8_t*& p, uint64_t* out) {
+  uint64_t w;
+  std::memcpy(&w, p, 8);
+  uint64_t term = ~w & 0x8080808080808080ULL;  // terminator high bits
+  if (!term) return false;  // >8-byte varint: caller falls back
+  int nbytes = (__builtin_ctzll(term) >> 3) + 1;
+  uint64_t mask = (nbytes == 8) ? ~0ULL : ((1ULL << (8 * nbytes)) - 1);
+  *out = _pext_u64(w & mask, 0x7F7F7F7F7F7F7F7FULL);
+  p += nbytes;
+  return true;
+}
+const bool g_has_bmi2 = __builtin_cpu_supports("bmi2");
+#endif
+
+inline bool turbo_varint_fast(const uint8_t*& p, const uint8_t* end, uint64_t* out) {
+#if defined(__x86_64__)
+  if (g_has_bmi2 && end - p >= 8 && turbo_varint_pext(p, out)) return true;
+#endif
+  return turbo_read_varint(p, end, out);
+}
+
 inline bool skip_field(Cursor& c, uint32_t wire_type) {
   uint64_t tmp;
   switch (wire_type) {
@@ -460,7 +505,9 @@ int64_t parse_feature_values(const uint8_t* fp, const uint8_t* fend,
           lc.p += plen;
           while (pc.p < pc.end) {
             uint64_t v;
-            if (!read_varint(pc, &v)) { err = "truncated varint"; return -1; }
+            // PEXT fast decode when available (token-id lists are the
+            // SequenceExample int hot case); falls back byte-wise
+            if (!turbo_varint_fast(pc.p, pc.end, &v)) { err = "truncated varint"; return -1; }
             if (!scalar || count == 0) col.push_i64((int64_t)v);
             count++;
           }
@@ -475,11 +522,24 @@ int64_t parse_feature_values(const uint8_t* fp, const uint8_t* fend,
           uint64_t plen;
           if (!read_varint(lc, &plen) || (uint64_t)(lc.end - lc.p) < plen || plen % 4) { err = "bad packed floats"; return -1; }
           uint64_t n = plen / 4;
-          for (uint64_t i = 0; i < n; i++) {
-            float v;
-            std::memcpy(&v, lc.p + 4 * i, 4);
-            if (!scalar || count == 0) col.push_f32(v);
-            count++;
+          if (!scalar && col.dtype == DT_F32 && !col.group_buf) {
+            // bulk path for ragged float columns (the SequenceExample
+            // frames hot case): one memcpy for the whole packed run
+            // instead of a per-value push loop — the wire bytes ARE the
+            // little-endian f32 layout the column stores
+            if (n) {  // memcpy with a null dest (empty vector) is UB
+              size_t old = col.f32.size();
+              col.f32.resize(old + n);
+              std::memcpy(col.f32.data() + old, lc.p, (size_t)plen);
+              count += (int64_t)n;
+            }
+          } else {
+            for (uint64_t i = 0; i < n; i++) {
+              float v;
+              std::memcpy(&v, lc.p + 4 * i, 4);
+              if (!scalar || count == 0) col.push_f32(v);
+              count++;
+            }
           }
           lc.p += plen;
         } else if (lwt == 5) {
@@ -731,50 +791,6 @@ struct TurboSlot {
   uint32_t value_len = 0;       // value payload bytes (BYTES/FLOAT: fixed)
 };
 
-inline bool turbo_read_varint(const uint8_t*& p, const uint8_t* end, uint64_t* out) {
-  if (p < end && !(*p & 0x80)) { *out = *p++; return true; }  // 1-byte fast case
-  uint64_t result = 0;
-  int shift = 0;
-  while (p < end) {
-    uint8_t b = *p++;
-    result |= (uint64_t)(b & 0x7F) << shift;
-    if (!(b & 0x80)) { *out = result; return true; }
-    shift += 7;
-    if (shift > 63) return false;
-  }
-  return false;
-}
-
-// Branch-light varint decode: load 8 bytes, locate the terminator byte with
-// ctz over the inverted continuation bits, extract the payload bits with
-// PEXT. Covers varints up to 8 bytes (56 bits — every int32-range feature);
-// longer ones and buffer tails fall back to the byte loop. Compiled with a
-// per-function target attribute and dispatched at runtime so the library
-// never executes PEXT on a CPU without BMI2 (and the binary itself is not
-// built -mbmi2). Note: PEXT is microcoded (slow) on AMD Zen1/Zen2; the
-// expected deployment (TPU host VMs) is Intel, where it is 3 cycles.
-#if defined(__x86_64__)
-__attribute__((target("bmi2"), noinline))
-bool turbo_varint_pext(const uint8_t*& p, uint64_t* out) {
-  uint64_t w;
-  std::memcpy(&w, p, 8);
-  uint64_t term = ~w & 0x8080808080808080ULL;  // terminator high bits
-  if (!term) return false;  // >8-byte varint: caller falls back
-  int nbytes = (__builtin_ctzll(term) >> 3) + 1;
-  uint64_t mask = (nbytes == 8) ? ~0ULL : ((1ULL << (8 * nbytes)) - 1);
-  *out = _pext_u64(w & mask, 0x7F7F7F7F7F7F7F7FULL);
-  p += nbytes;
-  return true;
-}
-const bool g_has_bmi2 = __builtin_cpu_supports("bmi2");
-#endif
-
-inline bool turbo_varint_fast(const uint8_t*& p, const uint8_t* end, uint64_t* out) {
-#if defined(__x86_64__)
-  if (g_has_bmi2 && end - p >= 8 && turbo_varint_pext(p, out)) return true;
-#endif
-  return turbo_read_varint(p, end, out);
-}
 
 
 // Parse one record in turbo mode. Returns true on success (columns written,
