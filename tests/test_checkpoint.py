@@ -123,6 +123,45 @@ class TestIdentityGuard:
             assert next(it).num_rows == 6
 
 
+class TestTrainCheckpointer:
+    ocp = pytest.importorskip("orbax.checkpoint")
+
+    def test_model_and_input_state_restore_together(self, sandbox, tmp_path):
+        """Params and input position persist under ONE orbax step dir, so a
+        restore can never pair step-N params with a stale input position."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        out = str(sandbox / "ds")
+        tfio.write([[i] for i in range(30)], SCHEMA, out, mode="overwrite")
+        ckdir = str(tmp_path / "ck")
+        ck = checkpoint.TrainCheckpointer(ckdir, max_to_keep=2)
+        ds = TFRecordDataset(out, batch_size=10, schema=SCHEMA)
+        it = ds.batches()
+        first = next(it)["uid"].values.tolist()
+        ck.save(1, {"w": jnp.full((3,), 7.0)}, it)
+        it.close()
+        ck.close()
+
+        ck2 = checkpoint.TrainCheckpointer(ckdir)
+        step, restored, resume = ck2.restore({"w": jnp.zeros((3,))})
+        assert step == 1
+        np.testing.assert_array_equal(np.asarray(restored["w"]), [7.0] * 3)
+        assert resume is not None and resume.fingerprint
+        rest = []
+        with TFRecordDataset(out, batch_size=10, schema=SCHEMA).batches(resume) as it2:
+            for b in it2:
+                rest.extend(b["uid"].values.tolist())
+        assert first + rest == list(range(30))
+        ck2.close()
+
+    def test_restore_without_checkpoint(self, tmp_path):
+        ck = checkpoint.TrainCheckpointer(str(tmp_path / "empty"))
+        step, tpl, resume = ck.restore({"a": 1})
+        assert step is None and resume is None and tpl == {"a": 1}
+        ck.close()
+
+
 def test_version_check(tmp_path):
     import json
 
