@@ -123,9 +123,14 @@ class TestIdentityGuard:
             assert next(it).num_rows == 6
 
 
-class TestTrainCheckpointer:
-    ocp = pytest.importorskip("orbax.checkpoint")
+import importlib.util
 
+
+@pytest.mark.skipif(
+    importlib.util.find_spec("orbax") is None,
+    reason="TrainCheckpointer requires the optional orbax-checkpoint package",
+)
+class TestTrainCheckpointer:
     def test_model_and_input_state_restore_together(self, sandbox, tmp_path):
         """Params and input position persist under ONE orbax step dir, so a
         restore can never pair step-N params with a stale input position."""

@@ -148,6 +148,25 @@ class TestTraining:
         params, opt_state, loss = step(params, opt_state, batch)
         assert np.isfinite(float(loss))
 
+    def test_remat_grads_match_non_remat(self):
+        """jax.checkpoint changes memory, never math: gradients with
+        remat=True must equal the plain backward."""
+        import dataclasses
+
+        params = long_doc.init_params(jax.random.key(0), CFG)
+        hb = long_doc.make_synthetic_batch(CFG, 8, seed=4)
+        batch = {k: jnp.asarray(v) for k, v in hb.items()}
+        cfg_r = dataclasses.replace(CFG, remat=True)
+        g_plain = jax.grad(lambda p: long_doc.loss_fn(p, batch, CFG))(params)
+        g_remat = jax.grad(lambda p: long_doc.loss_fn(p, batch, cfg_r))(params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            g_plain,
+            g_remat,
+        )
+
     def test_ring_hlo_has_collective_permute_no_allgather(self):
         """The SP path must ride ICI neighbor hops, not gather the sequence."""
         mesh = _mesh()

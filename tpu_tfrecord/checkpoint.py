@@ -33,6 +33,28 @@ def state_path(directory: str, process_index: Optional[int] = None) -> str:
     return os.path.join(directory, f"_input_state.{process_index}.json")
 
 
+def _extract_state(state_or_iterator) -> IteratorState:
+    return (
+        state_or_iterator.state()
+        if isinstance(state_or_iterator, CheckpointableIterator)
+        else state_or_iterator
+    )
+
+
+def _make_payload(state: IteratorState, step: Optional[int] = None) -> dict:
+    payload = {"version": _FORMAT_VERSION, "state": state.to_json()}
+    if step is not None:
+        payload["step"] = step
+    return payload
+
+
+def _check_version(payload: dict, where: str) -> None:
+    if payload.get("version") != _FORMAT_VERSION:
+        raise ValueError(
+            f"unsupported input-state version {payload.get('version')} {where}"
+        )
+
+
 def save_state(
     directory: str,
     state_or_iterator,
@@ -40,16 +62,10 @@ def save_state(
     step: Optional[int] = None,
 ) -> str:
     """Atomically persist iterator state; returns the file path."""
-    state = (
-        state_or_iterator.state()
-        if isinstance(state_or_iterator, CheckpointableIterator)
-        else state_or_iterator
-    )
+    state = _extract_state(state_or_iterator)
     os.makedirs(directory, exist_ok=True)
     path = state_path(directory, process_index)
-    payload = {"version": _FORMAT_VERSION, "state": state.to_json()}
-    if step is not None:
-        payload["step"] = step
+    payload = _make_payload(state, step)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as fh:
         json.dump(payload, fh)
@@ -66,10 +82,7 @@ def load_state(
         return None
     with open(path) as fh:
         payload = json.load(fh)
-    if payload.get("version") != _FORMAT_VERSION:
-        raise ValueError(
-            f"unsupported input-state version {payload.get('version')} at {path}"
-        )
+    _check_version(payload, f"at {path}")
     return IteratorState.from_json(payload["state"])
 
 
@@ -113,12 +126,7 @@ class TrainCheckpointer:
 
     def save(self, step: int, state_pytree, state_or_iterator) -> None:
         """Persist the model pytree and the input position for ``step``."""
-        state = (
-            state_or_iterator.state()
-            if isinstance(state_or_iterator, CheckpointableIterator)
-            else state_or_iterator
-        )
-        payload = {"version": _FORMAT_VERSION, "state": state.to_json(), "step": step}
+        payload = _make_payload(_extract_state(state_or_iterator), step)
         self._mgr.save(
             step,
             args=self._ocp.args.Composite(
@@ -145,11 +153,7 @@ class TrainCheckpointer:
             ),
         )
         payload = restored["input_state"]
-        if payload.get("version") != _FORMAT_VERSION:
-            raise ValueError(
-                f"unsupported input-state version {payload.get('version')} "
-                f"in checkpoint step {step}"
-            )
+        _check_version(payload, f"in checkpoint step {step}")
         return step, restored["state"], IteratorState.from_json(payload["state"])
 
     def close(self) -> None:
