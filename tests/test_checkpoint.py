@@ -1,5 +1,6 @@
 """Tests for checkpoint persistence of iterator state."""
 
+import importlib.util
 import os
 
 import pytest
@@ -123,11 +124,8 @@ class TestIdentityGuard:
             assert next(it).num_rows == 6
 
 
-import importlib.util
-
-
 @pytest.mark.skipif(
-    importlib.util.find_spec("orbax") is None,
+    importlib.util.find_spec("orbax.checkpoint") is None,
     reason="TrainCheckpointer requires the optional orbax-checkpoint package",
 )
 class TestTrainCheckpointer:

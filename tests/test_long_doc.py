@@ -167,6 +167,36 @@ class TestTraining:
             g_remat,
         )
 
+    def test_remat_grads_match_on_ring_attention_mesh(self):
+        """remat=True is FOR the long-context SP path: jax.checkpoint must
+        compile and differentiate through the shard_map + ppermute ring and
+        produce the same gradients as the non-remat sharded backward."""
+        import dataclasses
+
+        mesh = _mesh()
+        params = long_doc.init_params(jax.random.key(0), CFG)
+        hb = long_doc.make_synthetic_batch(CFG, 8, seed=5)
+        sh = long_doc.batch_shardings(mesh, hb)
+        batch = {k: jax.device_put(jnp.asarray(v), sh[k]) for k, v in hb.items()}
+        cfg_r = dataclasses.replace(CFG, remat=True)
+        g_plain = jax.jit(
+            jax.grad(
+                lambda p: long_doc.loss_fn(p, batch, CFG, mesh, data_axis="data")
+            )
+        )(params)
+        g_remat = jax.jit(
+            jax.grad(
+                lambda p: long_doc.loss_fn(p, batch, cfg_r, mesh, data_axis="data")
+            )
+        )(params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+            ),
+            g_plain,
+            g_remat,
+        )
+
     def test_ring_hlo_has_collective_permute_no_allgather(self):
         """The SP path must ride ICI neighbor hops, not gather the sequence."""
         mesh = _mesh()
