@@ -125,7 +125,8 @@ class TestIdentityGuard:
 
 
 @pytest.mark.skipif(
-    importlib.util.find_spec("orbax.checkpoint") is None,
+    importlib.util.find_spec("orbax") is None
+    or importlib.util.find_spec("orbax.checkpoint") is None,
     reason="TrainCheckpointer requires the optional orbax-checkpoint package",
 )
 class TestTrainCheckpointer:

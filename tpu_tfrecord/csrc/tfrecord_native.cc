@@ -730,6 +730,31 @@ bool parse_feature_lists(const uint8_t* p, const uint8_t* end, const FieldMap& f
       const uint8_t* fe = lc.p + flen;
       lc.p += flen;
       if (col.layout == LAYOUT_RAGGED2) {
+        // fast frame: the common float-frames shape is exactly
+        // [0x12 llen 0x0A plen <f32 run>] — bulk-append without the
+        // generic per-frame call; any deviation (empty, multi-segment,
+        // other kinds) takes the generic path below
+        if (col.kind == KIND_FLOAT && col.dtype == DT_F32 && fe - fs >= 4 &&
+            fs[0] == 0x12) {
+          const uint8_t* q = fs + 1;
+          uint64_t llen;
+          if (turbo_read_varint(q, fe, &llen) && (uint64_t)(fe - q) == llen &&
+              q < fe && *q == 0x0A) {
+            const uint8_t* q2 = q + 1;
+            uint64_t plen;
+            if (turbo_read_varint(q2, fe, &plen) &&
+                (uint64_t)(fe - q2) == plen && plen % 4 == 0 && plen > 0) {
+              size_t nf = (size_t)(plen / 4);
+              size_t old = col.f32.size();
+              col.f32.resize(old + nf);
+              std::memcpy(col.f32.data() + old, q2, (size_t)plen);
+              col.inner_count += (int64_t)nf;
+              col.inner_offsets.push_back(col.inner_count);
+              n_inner++;
+              continue;
+            }
+          }
+        }
         int64_t n = parse_feature_values(fs, fe, col, false, err);
         if (n == -1) return false;
         if (n == -2) n = 0;
