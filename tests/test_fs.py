@@ -152,6 +152,29 @@ def test_local_walk_ignores_dir_symlink_cycles(tmp_path):
     assert len(shards) == 1
 
 
+def test_failed_write_leaves_no_partial_output_memory(mem_url):
+    """A job that dies mid-write must leave NOTHING visible on the remote
+    store: no data files, no _SUCCESS (the temp-dir commit protocol must
+    hold on fsspec backends, not just local rename)."""
+    out = mem_url + "/aborted"
+
+    def exploding_rows():
+        yield [1, 1.0, "a"]
+        yield [2, 2.0, "b"]
+        raise RuntimeError("upstream died")
+
+    with pytest.raises(RuntimeError, match="upstream died"):
+        tfio.write(exploding_rows(), SCHEMA, out, mode="error")
+    fs = tfs.filesystem_for(out)
+    if fs.exists(out):
+        leftovers = [n for n in fs.listdir(out) if not n.startswith("_temporary")]
+        assert leftovers == [], leftovers
+    assert not tfio.has_success_marker(out)
+    # and a retry with the same mode succeeds cleanly afterwards
+    tfio.write(ROWS[:4], SCHEMA, out, mode="error")
+    assert len(tfio.read(out, schema=SCHEMA).rows) == 4
+
+
 def test_scheme_errors_cleanly(monkeypatch):
     # unknown protocol should raise a clear error, not silently read nothing
     with pytest.raises(Exception):
