@@ -21,6 +21,18 @@ def test_save_load_round_trip(tmp_path):
     assert checkpoint.load_state(str(tmp_path), process_index=9) is None
 
 
+def test_from_json_tolerates_unknown_keys():
+    """Regression (ADVICE r2): a newer writer's extra state fields (the way
+    'fingerprint' was added within format version 1) must load in an older
+    reader as a clean IteratorState, not crash with TypeError."""
+    st = IteratorState.from_json(
+        {"epoch": 1, "shard_cursor": 2, "record_offset": 3,
+         "fingerprint": "abc", "some_future_field": {"x": 1}}
+    )
+    assert st == IteratorState(epoch=1, shard_cursor=2, record_offset=3)
+    assert st.fingerprint == "abc"
+
+
 def test_save_from_live_iterator_and_resume(sandbox, tmp_path):
     out = str(sandbox / "ds")
     for s in range(3):

@@ -168,6 +168,20 @@ class TestShardedIngest:
         assert count == 4
         assert sorted(seen_uids) == list(range(32))
 
+    def test_device_iterator_rebuilds_shardings_on_ndim_change(self):
+        """Regression (ADVICE r2): the sharding cache was keyed only on dict
+        keys — an array whose RANK changes between batches must rebuild its
+        NamedSharding, not reuse a stale wrong-rank PartitionSpec."""
+        mesh = create_mesh()
+        n = mesh.devices.size
+        batches = [
+            {"x": np.arange(2 * n, dtype=np.int32).reshape(2 * n)},
+            {"x": np.ones((2 * n, 3), dtype=np.int32)},
+            {"x": np.arange(2 * n, dtype=np.int32).reshape(2 * n)},
+        ]
+        shapes = [gb["x"].shape for gb in DeviceIterator(iter(batches), mesh)]
+        assert shapes == [(2 * n,), (2 * n, 3), (2 * n,)]
+
 
 class TestSequenceIngest:
     def test_ragged2_to_dense_device_array(self, sandbox):

@@ -192,6 +192,34 @@ class TestZstd:
             fh.write(frame([b"c" * 500, b"d"]))
         assert list(wire.read_records(path)) == [b"a", b"b", b"c" * 500, b"d"]
 
+    def test_frame_ending_exactly_at_read_chunk_boundary(self, sandbox, monkeypatch):
+        """Regression (ADVICE r2): when a frame ends EXACTLY at the
+        _READ_CHUNK boundary, the decompressobj finishes with empty
+        unused_data; the next _fill must start a fresh decompressobj for the
+        following concatenated frame instead of feeding the finished one
+        (python-zstandard raises 'cannot use a decompressobj multiple
+        times', which was misreported as corruption on a valid file)."""
+        import zstandard
+
+        from tpu_tfrecord.wire import _ZstdFile
+
+        path = str(sandbox / "b.tfrecord.zst")
+        frame = lambda recs: zstandard.ZstdCompressor().compress(
+            b"".join(wire.encode_record(r) for r in recs)
+        )
+        f1 = frame([b"a" * 300, b"b"])
+        f2 = frame([b"c", b"d" * 200])
+        with open(path, "wb") as fh:
+            fh.write(f1)
+            fh.write(f2)
+        # Shrink the chunk size so the first frame ends exactly on a chunk
+        # boundary (constructing an exactly-1MiB compressed frame is flaky).
+        monkeypatch.setattr(_ZstdFile, "_READ_CHUNK", len(f1))
+        assert list(wire.read_records(path)) == [b"a" * 300, b"b", b"c", b"d" * 200]
+        # Also exercise a boundary mid-second-frame for good measure.
+        monkeypatch.setattr(_ZstdFile, "_READ_CHUNK", len(f1) + 3)
+        assert list(wire.read_records(path)) == [b"a" * 300, b"b", b"c", b"d" * 200]
+
     def test_dataset_reads_zstd_shards(self, sandbox):
         import tpu_tfrecord.io as tfio
         from tpu_tfrecord.io.dataset import TFRecordDataset

@@ -20,6 +20,7 @@
 #include <cstdint>
 #include <cstring>
 #include <cstdio>
+#include <mutex>
 #include <string>
 #include <string_view>
 #include <unordered_map>
@@ -83,10 +84,9 @@ uint32_t crc32c_sw(const uint8_t* p, uint64_t n, uint32_t crc) {
 // loop) and be combined afterwards — ~2x on the ~1KB payloads TFRecord
 // shards typically carry.
 uint32_t crc_shift256_tbl[4][256];
-bool crc_shift256_init_done = false;
+std::once_flag crc_shift256_once;
 
-void init_crc_shift256() {
-  if (crc_shift256_init_done) return;
+void init_crc_shift256_impl() {
   uint32_t basis[32];
   for (int b = 0; b < 32; b++) {
     uint32_t c = 1u << b;
@@ -101,8 +101,11 @@ void init_crc_shift256() {
       crc_shift256_tbl[k][v] = acc;
     }
   }
-  crc_shift256_init_done = true;
 }
+
+// Decode worker threads (num_workers>1) may race the lazy init; call_once
+// gives the table stores release/acquire ordering a plain bool guard lacks.
+void init_crc_shift256() { std::call_once(crc_shift256_once, init_crc_shift256_impl); }
 
 inline uint32_t crc_shift256(uint32_t c) {
   return crc_shift256_tbl[0][c & 0xFF] ^ crc_shift256_tbl[1][(c >> 8) & 0xFF] ^

@@ -23,7 +23,7 @@ import queue
 import threading
 import time
 import weakref
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, field, fields, replace
 from typing import Any, Dict, Iterator, List, Optional
 
 import numpy as np
@@ -79,7 +79,12 @@ class IteratorState:
 
     @staticmethod
     def from_json(obj: Dict[str, Any]) -> "IteratorState":
-        return IteratorState(**obj)
+        # Tolerate unknown keys: state files are forward-compatible within a
+        # format version (e.g. 'fingerprint' was added without a version
+        # bump), so a newer writer's extra fields must not crash an older
+        # reader with a TypeError from the constructor.
+        known = {f.name for f in fields(IteratorState)}
+        return IteratorState(**{k: v for k, v in obj.items() if k in known})
 
 
 class TFRecordDataset:

@@ -309,10 +309,16 @@ class DeviceIterator:
         self._axis = axis
         self._pending: Optional[Dict[str, jax.Array]] = None
         self._shardings: Optional[Dict[str, NamedSharding]] = None
+        self._sharding_key: Optional[Dict[str, int]] = None
 
     def _transfer(self, host: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
-        if self._shardings is None or self._shardings.keys() != host.keys():
+        # Cache key includes each array's ndim: a same-named array changing
+        # rank between batches must rebuild its NamedSharding (a stale
+        # PartitionSpec of the wrong rank would shard incorrectly or fail).
+        shape_key = {name: arr.ndim for name, arr in host.items()}
+        if self._shardings is None or self._sharding_key != shape_key:
             self._shardings = data_shardings(host, self._mesh, self._axis)
+            self._sharding_key = shape_key
         return make_global_batch(host, self._mesh, self._axis, self._shardings)
 
     def __iter__(self) -> Iterator[Dict[str, jax.Array]]:

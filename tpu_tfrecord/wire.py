@@ -258,6 +258,12 @@ class _ZstdFile(io.RawIOBase):
             return
         try:
             while raw:
+                if self._dobj.eof:
+                    # The previous frame ended exactly at a read-chunk
+                    # boundary (eof=True, empty unused_data): a finished
+                    # decompressobj cannot be fed again, so start a fresh
+                    # one for the next concatenated frame.
+                    self._dobj = self._zstd.ZstdDecompressor().decompressobj()
                 self._pending += self._dobj.decompress(raw)
                 if self._dobj.eof:
                     # concatenated frames: restart on the leftover input
