@@ -63,21 +63,55 @@ class TFRecordDataSource:
 register_format(TFRecordDataSource.short_name, TFRecordDataSource)
 
 
+# read() materializes EVERYTHING as Python row lists — ~10-50x the on-disk
+# bytes in memory. Refuse datasets beyond this size unless the caller opts
+# in (limit=, bigger max_bytes=, or max_bytes=None).
+_READ_MAX_BYTES_DEFAULT = 4 << 30
+
+
 def read(
     paths,
     columns: Optional[List[str]] = None,
+    limit: Optional[int] = None,
+    max_bytes: Optional[int] = _READ_MAX_BYTES_DEFAULT,
     options: Optional[TFRecordOptions] = None,
     **option_kwargs: Any,
 ) -> Table:
     """Read a TFRecord dataset fully into a Table (schema inferred unless
-    given). For streaming/TPU ingestion use ``reader()`` / tpu_tfrecord.tpu."""
+    given). For streaming/TPU ingestion use ``reader()`` / tpu_tfrecord.tpu.
+
+    ``limit`` caps the number of materialized rows (a cheap head over a big
+    dataset). Without a limit, datasets whose on-disk size exceeds
+    ``max_bytes`` (default 4 GiB) are refused with guidance — Python row
+    lists cost an order of magnitude more RAM than the files themselves.
+    """
     r = (
         DatasetReader(paths, options=options)
         if options is not None
         else DatasetReader(paths, **option_kwargs)
     )
+    if limit is None and max_bytes is not None:
+        total = sum(sh.size for sh in r.shards)
+        if total > max_bytes:
+            raise ValueError(
+                f"dataset is {total / (1 << 30):.1f} GiB on disk, over the "
+                f"read() guard of {max_bytes / (1 << 30):.1f} GiB; "
+                "materializing it as Python rows would need far more RAM. "
+                "Use tpu_tfrecord.io.reader() or "
+                "tpu_tfrecord.io.dataset.TFRecordDataset to stream, pass "
+                "limit=N for a head, or raise/disable with max_bytes="
+            )
     schema = r.schema() if columns is None else r.schema().select(columns)
-    return Table(schema, [list(row) for row in r.rows(columns)])
+    out: List[List[Any]] = []
+    rows_it = r.rows(columns)
+    try:
+        for row in rows_it:
+            if limit is not None and len(out) >= limit:
+                break
+            out.append(list(row))
+    finally:
+        rows_it.close()  # early break mid-shard: close the file now, not at GC
+    return Table(schema, out)
 
 
 def reader(paths, options: Optional[TFRecordOptions] = None, **option_kwargs: Any) -> DatasetReader:

@@ -807,13 +807,16 @@ class CheckpointableIterator:
         dataset fingerprint so a later resume validates identity."""
         return replace(self._consumed_state, fingerprint=self._ds.fingerprint())
 
-    def close(self) -> None:
+    def close(self, _empty=queue.Empty) -> None:
+        # queue.Empty is bound as a default arg: close() can run during
+        # interpreter shutdown (an abandoned iterator collected late), when
+        # module globals — including our `queue` import — are already None.
         self._stop.set()
         # Drain so the producer unblocks and exits.
         try:
             while True:
                 self._queue.get_nowait()
-        except queue.Empty:
+        except _empty:
             pass
 
     def __enter__(self) -> "CheckpointableIterator":

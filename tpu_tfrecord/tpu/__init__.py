@@ -16,6 +16,7 @@ from tpu_tfrecord.tpu.mesh import (
 )
 from tpu_tfrecord.tpu.ingest import (
     DeviceIterator,
+    HostPrefetcher,
     batch_spec,
     data_shardings,
     hash_bytes_column,
@@ -34,4 +35,5 @@ __all__ = [
     "make_global_batch",
     "hash_bytes_column",
     "DeviceIterator",
+    "HostPrefetcher",
 ]

@@ -288,6 +288,84 @@ def make_global_batch(
     return out
 
 
+class HostPrefetcher:
+    """Run a host-batch iterator in a background thread behind a bounded
+    queue.
+
+    The dataset's decode already overlaps (its own producer thread, GIL
+    released in the native codec), but the numpy tail of batch production —
+    pad/pack/hash in ``host_batch_from_columnar`` — otherwise runs inline in
+    the consumer thread, inside the device's input-wait. Wrapping the host
+    batch generator here moves that work off the critical path too, which is
+    what keeps the duty cycle >=95% when batch assembly is non-trivial
+    (ragged padding, many columns). Iterate it, or use as a context manager;
+    ``close()`` unblocks and joins the worker."""
+
+    _DONE = object()
+
+    def __init__(self, host_batches: Iterable[Dict[str, np.ndarray]], depth: int = 2):
+        import queue
+        import threading
+
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._empty = queue.Empty  # shutdown-safe binding (module may be gone)
+        self._finished: Optional[object] = None
+
+        def _produce():
+            try:
+                for hb in host_batches:
+                    while not self._stop.is_set():
+                        try:
+                            self._queue.put(hb, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._stop.is_set():
+                        return
+                self._queue.put(self._DONE)
+            except BaseException as e:  # noqa: BLE001 — repropagated in consumer
+                self._queue.put(e)
+
+        self._thread = threading.Thread(target=_produce, daemon=True)
+        self._thread.start()
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        return self
+
+    def __next__(self) -> Dict[str, np.ndarray]:
+        # The sentinel/exception arrives on the queue exactly once — cache
+        # it so a second next() after exhaustion re-raises instead of
+        # blocking forever on an empty queue with a dead producer.
+        if self._finished is not None:
+            if self._finished is self._DONE:
+                raise StopIteration
+            raise self._finished
+        item = self._queue.get()
+        if item is self._DONE:
+            self._finished = item
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._finished = item
+            raise item
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except self._empty:
+            pass
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "HostPrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
 class DeviceIterator:
     """Double-buffered device feeder: host batches -> sharded global batches.
 
