@@ -289,14 +289,30 @@ class TestDutyCycleOverlap:
 
         mesh = create_mesh()
         n = mesh.devices.size
-        cost = 0.04
+        w, step = _heavy_step(20)
+
+        # Calibrate the producer cost to the MEASURED step time on this
+        # machine (a hard-coded sleep breaks on faster hosts where the
+        # producer could no longer keep up). The probe input must be
+        # sharded exactly like the loop's batches: with an unsharded probe
+        # the scan runs on one device instead of replicated on all 8, which
+        # under-measures the step ~8x on this box. cost ~ step/2 keeps the
+        # producer comfortably ahead overlapped, yet far over the 5% wait
+        # budget serialized.
+        probe = make_global_batch({"x": np.zeros((2 * n,), dtype=np.float32)},
+                                  mesh)
+        jax.block_until_ready(step(w, probe))  # compile
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(step(w, probe))
+            times.append(time.perf_counter() - t0)
+        cost = max(min(times) / 2, 0.002)
 
         def slow_batches(count=12):
             for i in range(count):
                 time.sleep(cost)  # stand-in for pad/pack/hash numpy work
                 yield {"x": np.full((2 * n,), i, dtype=np.float32)}
-
-        w, step = _heavy_step(12)
         serial = _measure_duty(DeviceIterator(slow_batches(), mesh), w, step,
                                n_steps=6)
         with HostPrefetcher(slow_batches()) as pf:
@@ -338,3 +354,16 @@ class TestDutyCycleOverlap:
                 next(pf)
             with pytest.raises(RuntimeError, match="decode exploded"):
                 next(pf)
+
+    def test_host_prefetcher_next_after_close_raises(self):
+        """next() on a closed prefetcher raises StopIteration rather than
+        blocking forever on a queue whose producer is gone."""
+        def gen():
+            for i in range(100):
+                yield {"x": np.full(8, i, dtype=np.int32)}
+
+        pf = HostPrefetcher(gen())
+        next(pf)
+        pf.close()
+        with pytest.raises(StopIteration):
+            next(pf)

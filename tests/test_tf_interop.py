@@ -1,0 +1,145 @@
+"""Ecosystem interop pinned against the REAL TensorFlow runtime (VERDICT r2
+next-step #4): files written by tf.io.TFRecordWriter (uncompressed / GZIP /
+ZLIB) must read, infer, and decode here; files written here must parse with
+tf.train.Example and stream through tf.data.TFRecordDataset.
+
+TF import is heavy (~15s) — everything is module-level gated so the suite
+still runs where TF is absent.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import tpu_tfrecord.io as tfio
+from tpu_tfrecord import infer, wire
+from tpu_tfrecord.options import RecordType
+from tpu_tfrecord.schema import (
+    ArrayType,
+    FloatType,
+    LongType,
+    StringType,
+    StructField,
+    StructType,
+)
+
+SCHEMA = StructType(
+    [
+        StructField("uid", LongType()),
+        StructField("score", FloatType()),
+        StructField("emb", ArrayType(FloatType())),
+        StructField("name", StringType()),
+    ]
+)
+
+
+def _tf_example(uid, score, emb, name):
+    return tf.train.Example(
+        features=tf.train.Features(
+            feature={
+                "uid": tf.train.Feature(int64_list=tf.train.Int64List(value=[uid])),
+                "score": tf.train.Feature(float_list=tf.train.FloatList(value=[score])),
+                "emb": tf.train.Feature(float_list=tf.train.FloatList(value=emb)),
+                "name": tf.train.Feature(
+                    bytes_list=tf.train.BytesList(value=[name.encode()])
+                ),
+            }
+        )
+    )
+
+
+def _write_with_tf(path, n, compression=""):
+    opts = tf.io.TFRecordOptions(compression_type=compression)
+    with tf.io.TFRecordWriter(path, opts) as w:
+        for i in range(n):
+            w.write(
+                _tf_example(i, i / 2.0, [float(i), float(i + 1)], f"n{i}")
+                .SerializeToString()
+            )
+
+
+# TF's compression names -> ours (ZLIB is a bare zlib stream = deflate)
+TF_CODECS = [("", None, ""), ("GZIP", "gzip", ".gz"), ("ZLIB", "deflate", ".deflate")]
+
+
+class TestTFWritesWeRead:
+    @pytest.mark.parametrize("tf_codec,codec,ext", TF_CODECS)
+    def test_read_and_infer(self, sandbox, tf_codec, codec, ext):
+        path = str(sandbox / f"tfw.tfrecord{ext}")
+        _write_with_tf(path, 8, tf_codec)
+        # explicit schema decode
+        table = tfio.read(path, schema=SCHEMA, codec=codec)
+        rows = sorted(table.to_dicts(), key=lambda d: d["uid"])
+        assert rows[3]["uid"] == 3
+        assert rows[3]["score"] == pytest.approx(1.5)
+        assert rows[3]["emb"] == pytest.approx([3.0, 4.0])
+        assert rows[3]["name"] == "n3"
+        # schema inference from TF-written bytes (extension autodetect)
+        inferred = tfio.reader(path).schema()
+        assert {f.name for f in inferred} == {"uid", "score", "emb", "name"}
+
+    def test_wire_level_crc_agreement(self, sandbox):
+        """Byte-level: the records TF framed verify under our CRC check."""
+        path = str(sandbox / "crc.tfrecord")
+        _write_with_tf(path, 4)
+        recs = list(wire.read_records(path))  # verify_crc on by default
+        assert len(recs) == 4
+        ex = tf.train.Example.FromString(recs[0])
+        assert ex.features.feature["uid"].int64_list.value[0] == 0
+
+
+class TestWeWriteTFReads:
+    @pytest.mark.parametrize("tf_codec,codec,ext", TF_CODECS)
+    def test_tf_data_pipeline_parses(self, sandbox, tf_codec, codec, ext):
+        out = str(sandbox / f"ours_{codec}")
+        rows = [[i, i / 2.0, [float(i)], f"n{i}"] for i in range(10)]
+        tfio.write(rows, SCHEMA, out, mode="overwrite", codec=codec)
+        shards = sorted(glob.glob(os.path.join(out, f"part-*.tfrecord{ext}")))
+        assert shards
+        ds = tf.data.TFRecordDataset(shards, compression_type=tf_codec)
+        uids = []
+        for raw in ds:
+            ex = tf.train.Example.FromString(raw.numpy())
+            uids.append(int(ex.features.feature["uid"].int64_list.value[0]))
+        assert sorted(uids) == list(range(10))
+
+    def test_sequence_example_cross_parse(self, sandbox):
+        schema = StructType(
+            [
+                StructField("id", LongType()),
+                StructField("frames", ArrayType(ArrayType(FloatType()))),
+            ]
+        )
+        out = str(sandbox / "seq")
+        tfio.write(
+            [[7, [[1.0, 2.0], [3.0]]]], schema, out, mode="overwrite",
+            recordType="SequenceExample",
+        )
+        shard = glob.glob(os.path.join(out, "part-*.tfrecord"))[0]
+        raw = next(iter(tf.data.TFRecordDataset([shard]))).numpy()
+        se = tf.train.SequenceExample.FromString(raw)
+        assert se.context.feature["id"].int64_list.value[0] == 7
+        fl = se.feature_lists.feature_list["frames"].feature
+        assert [list(f.float_list.value) for f in fl] == [[1.0, 2.0], [3.0]]
+
+    def test_tf_parse_example_op(self, sandbox):
+        """Our bytes through TF's actual parsing op (tf.io.parse_example)."""
+        out = str(sandbox / "pe")
+        tfio.write([[1, 0.5, [1.0, 2.0], "a"], [2, 1.5, [3.0, 4.0], "b"]],
+                   SCHEMA, out, mode="overwrite")
+        shard = glob.glob(os.path.join(out, "part-*.tfrecord"))[0]
+        raws = [r.numpy() for r in tf.data.TFRecordDataset([shard])]
+        parsed = tf.io.parse_example(
+            tf.constant(raws),
+            {
+                "uid": tf.io.FixedLenFeature([], tf.int64),
+                "emb": tf.io.FixedLenFeature([2], tf.float32),
+            },
+        )
+        np.testing.assert_array_equal(
+            np.sort(parsed["uid"].numpy()), np.array([1, 2])
+        )

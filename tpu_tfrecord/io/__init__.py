@@ -72,9 +72,10 @@ _READ_MAX_BYTES_DEFAULT = 4 << 30
 def read(
     paths,
     columns: Optional[List[str]] = None,
+    options: Optional[TFRecordOptions] = None,
+    *,
     limit: Optional[int] = None,
     max_bytes: Optional[int] = _READ_MAX_BYTES_DEFAULT,
-    options: Optional[TFRecordOptions] = None,
     **option_kwargs: Any,
 ) -> Table:
     """Read a TFRecord dataset fully into a Table (schema inferred unless

@@ -352,6 +352,10 @@ class HostPrefetcher:
 
     def close(self) -> None:
         self._stop.set()
+        if self._finished is None:
+            # Post-close iteration must raise StopIteration, not park on a
+            # queue whose producer is gone.
+            self._finished = self._DONE
         try:
             while True:
                 self._queue.get_nowait()

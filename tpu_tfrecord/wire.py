@@ -131,12 +131,31 @@ _CODEC_ALIASES = {
     "deflate": "deflate",
     "zlib": "deflate",
     "org.apache.hadoop.io.compress.defaultcodec": "deflate",
+    "org.apache.hadoop.io.compress.deflatecodec": "deflate",
     "zstd": "zstd",
     "zstandard": "zstd",
     "org.apache.hadoop.io.compress.zstandardcodec": "zstd",
+    # Full Hadoop passthrough breadth (ref DefaultSource.scala:95-102
+    # forwards ANY codec class name into the Hadoop conf): snappy and lz4
+    # via the dependency-free implementations in hadoop_codecs.py, bzip2
+    # via stdlib bz2.
+    "snappy": "snappy",
+    "org.apache.hadoop.io.compress.snappycodec": "snappy",
+    "lz4": "lz4",
+    "org.apache.hadoop.io.compress.lz4codec": "lz4",
+    "bzip2": "bzip2",
+    "bz2": "bzip2",
+    "org.apache.hadoop.io.compress.bzip2codec": "bzip2",
 }
 
-_CODEC_EXTENSIONS = {"gzip": ".gz", "deflate": ".deflate", "zstd": ".zst"}
+_CODEC_EXTENSIONS = {
+    "gzip": ".gz",
+    "deflate": ".deflate",
+    "zstd": ".zst",
+    "snappy": ".snappy",
+    "lz4": ".lz4",
+    "bzip2": ".bz2",
+}
 
 
 def _zstandard():
@@ -163,7 +182,8 @@ def normalize_codec(codec: Optional[str]) -> Optional[str]:
         return resolved
     raise ValueError(
         f"Unsupported codec {codec!r}: supported codecs are 'gzip', "
-        "'deflate', and 'zstd' (or their Hadoop class names)"
+        "'deflate', 'zstd', 'snappy', 'lz4', and 'bzip2' (or their Hadoop "
+        "class names)"
     )
 
 
@@ -182,6 +202,12 @@ def codec_from_path(path: str) -> Optional[str]:
         return "deflate"
     if lower.endswith(".zst") or lower.endswith(".zstd"):
         return "zstd"
+    if lower.endswith(".snappy"):
+        return "snappy"
+    if lower.endswith(".lz4"):
+        return "lz4"
+    if lower.endswith(".bz2") or lower.endswith(".bzip2"):
+        return "bzip2"
     return None
 
 
@@ -206,6 +232,14 @@ def open_compressed(path: str, mode: str, codec: Optional[str]) -> BinaryIO:
         return _DeflateFile(path, mode, fileobj=raw)
     if codec == "zstd":
         return _ZstdFile(path, mode, fileobj=raw)
+    if codec in ("snappy", "lz4"):
+        from tpu_tfrecord.hadoop_codecs import HadoopBlockFile
+
+        return HadoopBlockFile(path, mode, codec, fileobj=raw)
+    if codec == "bzip2":
+        from tpu_tfrecord.hadoop_codecs import Bz2File
+
+        return Bz2File(path, mode, fileobj=raw)
     return raw
 
 
