@@ -1,0 +1,231 @@
+"""Remote-filesystem fault injection (VERDICT r2 missing #3 / next-step #5).
+
+The local tests prove read_retries, truncation detection, and the atomic
+write-job abort against injected LOCAL faults; these prove the same
+contracts on the REMOTE path by wrapping the fsspec file objects the real
+read/write code opens: transient mid-read errors, permanently flaky
+streams, object-store-style short reads, slow reads, and upload-on-close
+failures. The reference inherits all of this from Hadoop FS semantics
+(TFRecordFileReader.scala:24-32, TFRecordOutputWriter.scala:19).
+"""
+
+import uuid
+
+import pytest
+
+import tpu_tfrecord.io as tfio
+from tpu_tfrecord import fs as tfs, wire
+from tpu_tfrecord.io.dataset import TFRecordDataset
+from tpu_tfrecord.schema import LongType, StringType, StructField, StructType
+
+fsspec = pytest.importorskip("fsspec")
+
+SCHEMA = StructType(
+    [StructField("id", LongType(), nullable=False), StructField("s", StringType())]
+)
+ROWS = [[i, f"val{i}" * (i % 4 + 1)] for i in range(60)]
+
+
+@pytest.fixture
+def mem_url():
+    url = f"memory://faults-{uuid.uuid4().hex[:8]}"
+    yield url
+    mem = fsspec.filesystem("memory")
+    try:
+        mem.rm(url.split("://", 1)[1], recursive=True)
+    except FileNotFoundError:
+        pass
+
+
+class _FaultyFile:
+    """Wraps an fsspec file: optional per-read byte cap (object-store short
+    reads), a one-shot OSError raised mid-stream after N bytes, and an
+    OSError from close() on write streams (failed upload flush)."""
+
+    def __init__(self, inner, plan, path):
+        self._inner = inner
+        self._plan = plan
+        self._path = path
+        self._read_bytes = 0
+
+    def _maybe_fail(self):
+        remaining = self._plan.read_faults.get(self._path, 0)
+        if remaining and self._read_bytes >= self._plan.fail_after_bytes:
+            self._plan.read_faults[self._path] = remaining - 1
+            raise OSError(f"injected transient read error on {self._path}")
+
+    def read(self, size=-1):
+        self._maybe_fail()
+        if self._plan.short_read_cap and size is not None and size > 0:
+            size = min(size, self._plan.short_read_cap)
+        data = self._inner.read(size)
+        self._read_bytes += len(data)
+        return data
+
+    def readinto(self, b):
+        data = self.read(len(b))
+        b[: len(data)] = data
+        return len(data)
+
+    def write(self, data):
+        return self._inner.write(data)
+
+    def close(self):
+        try:
+            if self._plan.close_faults and not self._inner.closed and \
+                    "w" in getattr(self._inner, "mode", "w"):
+                if any(k in self._path for k in self._plan.close_faults):
+                    # the backend buffer is dropped, mirroring a failed
+                    # object-store upload: nothing becomes visible
+                    self._inner.close()
+                    raise OSError(f"injected upload failure on close: {self._path}")
+        finally:
+            if not self._inner.closed:
+                self._inner.close()
+
+    @property
+    def closed(self):
+        return self._inner.closed
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class _FaultPlan:
+    def __init__(self):
+        self.read_faults = {}       # full path -> remaining one-shot errors
+        self.fail_after_bytes = 0   # bytes served before an armed error fires
+        self.short_read_cap = 0     # 0 = off
+        self.close_faults = set()   # path substrings whose close() fails
+
+
+@pytest.fixture
+def faulty_fs(monkeypatch):
+    plan = _FaultPlan()
+    orig = tfs.FsspecFS.open
+
+    def open_(self, path, mode):
+        return _FaultyFile(orig(self, path, mode), plan, path)
+
+    monkeypatch.setattr(tfs.FsspecFS, "open", open_)
+    return plan
+
+
+def _write_remote(mem_url, n_shards=3):
+    out = mem_url + "/ds"
+    per = len(ROWS) // n_shards
+    for s in range(n_shards):
+        tfio.write(ROWS[s * per : (s + 1) * per], SCHEMA, out,
+                   mode="append" if s else "overwrite")
+    return out
+
+
+def _read_all_ids(out, **kw):
+    ds = TFRecordDataset(out, batch_size=7, schema=SCHEMA,
+                         drop_remainder=False, **kw)
+    got = []
+    with ds.batches() as it:
+        for cb in it:
+            got.extend(cb["id"].values.tolist())
+    return got
+
+
+class TestRemoteReadFaults:
+    def test_transient_error_retries_without_dups_or_holes(self, mem_url, faulty_fs):
+        out = _write_remote(mem_url)
+        shards = [s.path for s in tfio.discover_shards(out)]
+        faulty_fs.fail_after_bytes = 100  # mid-stream, not on open
+        faulty_fs.read_faults = {p: 1 for p in shards}  # one failure each
+        got = _read_all_ids(out, read_retries=2)
+        assert sorted(got) == sorted(r[0] for r in ROWS)
+        assert all(v == 0 for v in faulty_fs.read_faults.values())  # all fired
+
+    def test_retries_exhausted_raises(self, mem_url, faulty_fs):
+        out = _write_remote(mem_url)
+        shards = [s.path for s in tfio.discover_shards(out)]
+        faulty_fs.fail_after_bytes = 50
+        faulty_fs.read_faults = {shards[0]: 100}  # permanently flaky
+        with pytest.raises(OSError, match="injected transient"):
+            _read_all_ids(out, read_retries=2)
+
+    def test_short_and_slow_reads_stream_correctly(self, mem_url, faulty_fs):
+        """Object-store-style short reads (every read capped at 7 bytes)
+        must stream through the slab carry logic, never misread as EOF."""
+        out = _write_remote(mem_url)
+        faulty_fs.short_read_cap = 7
+        got = _read_all_ids(out)
+        assert sorted(got) == sorted(r[0] for r in ROWS)
+        # and the row-level reader path
+        table = tfio.read(out, schema=SCHEMA)
+        assert sorted(table.column("id")) == sorted(r[0] for r in ROWS)
+
+    @pytest.mark.parametrize("codec", ["gzip", "deflate", "zstd", "snappy",
+                                       "lz4", "bzip2"])
+    def test_short_reads_through_codec_streams(self, mem_url, faulty_fs, codec):
+        """Every codec's framing reader must loop over short reads (3-byte
+        cap: even the 4-byte Hadoop block headers split) instead of
+        misreporting a valid remote file as truncated."""
+        out = mem_url + f"/short_{codec}"
+        tfio.write(ROWS[:20], SCHEMA, out, mode="overwrite", codec=codec)
+        faulty_fs.short_read_cap = 3
+        table = tfio.read(out, schema=SCHEMA)
+        assert sorted(table.column("id")) == list(range(20))
+
+    def test_remote_truncation_detected(self, mem_url, faulty_fs):
+        out = _write_remote(mem_url, n_shards=1)
+        shard = tfio.discover_shards(out)[0].path
+        mem = fsspec.filesystem("memory")
+        key = shard.split("://", 1)[1]
+        blob = mem.cat_file(key)
+        mem.pipe_file(key, blob[: len(blob) - 5])
+        with pytest.raises(wire.TFRecordCorruptionError):
+            _read_all_ids(out)
+
+    def test_remote_gzip_truncation_detected(self, mem_url, faulty_fs):
+        out = mem_url + "/gz"
+        tfio.write(ROWS[:20], SCHEMA, out, mode="overwrite", codec="gzip")
+        shard = tfio.discover_shards(out)[0].path
+        mem = fsspec.filesystem("memory")
+        key = shard.split("://", 1)[1]
+        blob = mem.cat_file(key)
+        mem.pipe_file(key, blob[: len(blob) // 2])
+        with pytest.raises((wire.TFRecordCorruptionError, OSError, EOFError)):
+            _read_all_ids(out)
+
+
+class TestRemoteWriteFaults:
+    def test_upload_on_close_failure_aborts_cleanly(self, mem_url, faulty_fs):
+        """A part-file whose close() fails (object-store upload flush) must
+        surface the error AND leave nothing visible: no data files, no
+        _SUCCESS; a later retry succeeds."""
+        out = mem_url + "/aborted"
+        faulty_fs.close_faults = {"part-"}
+        with pytest.raises(OSError, match="injected upload failure"):
+            tfio.write(ROWS[:10], SCHEMA, out, mode="error")
+        fs = tfs.filesystem_for(out)
+        if fs.exists(out):
+            visible = [n for n in fs.listdir(out) if not n.startswith("_temporary")]
+            assert visible == [], visible
+        assert not tfio.has_success_marker(out)
+        faulty_fs.close_faults = set()
+        tfio.write(ROWS[:10], SCHEMA, out, mode="error")
+        assert sorted(tfio.read(out, schema=SCHEMA).column("id")) == list(range(10))
+
+    def test_success_marker_close_failure_propagates(self, mem_url, faulty_fs):
+        """Even the _SUCCESS marker upload failing must not report success."""
+        out = mem_url + "/marker"
+        faulty_fs.close_faults = {"_SUCCESS"}
+        try:
+            tfio.write(ROWS[:4], SCHEMA, out, mode="error")
+            wrote_ok = True
+        except OSError:
+            wrote_ok = False
+        if wrote_ok:
+            # acceptable only if the marker actually became visible
+            assert tfio.has_success_marker(out)

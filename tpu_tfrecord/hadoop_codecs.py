@@ -38,6 +38,24 @@ def _corruption(msg: str) -> Exception:
     return TFRecordCorruptionError(msg)
 
 
+def _read_exact(fh, n: int) -> bytes:
+    """Read exactly n bytes, looping over short reads (remote/object-store
+    streams may legally return fewer bytes per call than asked; only a
+    0-byte read is EOF)."""
+    data = fh.read(n)
+    if len(data) in (0, n):
+        return data
+    parts = [data]
+    got = len(data)
+    while got < n:
+        more = fh.read(n - got)
+        if not more:
+            break
+        parts.append(more)
+        got += len(more)
+    return b"".join(parts)
+
+
 # ---------------------------------------------------------------------------
 # Raw snappy
 # ---------------------------------------------------------------------------
@@ -297,7 +315,7 @@ class HadoopBlockFile(io.RawIOBase):
     # -- read side ---------------------------------------------------------
 
     def _read_be4(self, what: str) -> Optional[int]:
-        hdr = self._raw.read(4)
+        hdr = _read_exact(self._raw, 4)
         if not hdr:
             return None  # clean EOF only at a block boundary
         if len(hdr) < 4:
@@ -319,7 +337,7 @@ class HadoopBlockFile(io.RawIOBase):
                     f"truncated {self._codec} stream in {self._path}: "
                     "EOF inside a block"
                 )
-            chunk = self._raw.read(chunk_len)
+            chunk = _read_exact(self._raw, chunk_len)
             if len(chunk) < chunk_len:
                 raise _corruption(
                     f"truncated {self._codec} stream in {self._path}: "
