@@ -68,6 +68,34 @@ def main() -> None:
     uid = gb["uid"]
     global_sum = float(jax.jit(lambda x: x.sum())(uid))
 
+    # --- fingerprint-guarded mid-stream resume on this host's assignment ---
+    # First batch -> state() (stamped with the dataset fingerprint) -> a NEW
+    # dataset resumes from it; first + rest must equal a straight full read.
+    ds_a = TFRecordDataset(
+        data_dir, batch_size=4, schema=schema, drop_remainder=False,
+        process_index=pid, process_count=num_procs,
+    )
+    with ds_a.batches() as it:
+        first = next(it)["uid"].values.tolist()
+        state = it.state()
+    ds_b = TFRecordDataset(
+        data_dir, batch_size=4, schema=schema, drop_remainder=False,
+        process_index=pid, process_count=num_procs,
+    )
+    rest = []
+    with ds_b.batches(state) as it:
+        for cb in it:
+            rest.extend(cb["uid"].values.tolist())
+    full = []
+    ds_c = TFRecordDataset(
+        data_dir, batch_size=4, schema=schema, drop_remainder=False,
+        process_index=pid, process_count=num_procs,
+    )
+    with ds_c.batches() as it:
+        for cb in it:
+            full.extend(cb["uid"].values.tolist())
+    resume_ok = (first + rest == full) and state.fingerprint is not None
+
     # --- coordinated multi-host write: per-host shards, one _SUCCESS ---
     from tpu_tfrecord.io.writer import DatasetWriter
     from tpu_tfrecord.options import TFRecordOptions
@@ -87,6 +115,21 @@ def main() -> None:
     # the double barrier guarantees the marker exists once the call returns
     marker_after = os.path.exists(os.path.join(out_dir, "_SUCCESS"))
 
+    # --- coordinated partitionBy write: col=value dirs from every host ---
+    part_dir = os.path.join(os.path.dirname(data_dir), "mh_part")
+    os.makedirs(part_dir, exist_ok=True)
+    p_schema = StructType(
+        [StructField("uid", LongType()), StructField("par", LongType())]
+    )
+    p_writer = DatasetWriter(
+        part_dir, p_schema, TFRecordOptions(), mode="append",
+        partition_by=["par"], write_success=False,
+    )
+    p_writer.write_rows(
+        [[1000 * pid + v, v % 2] for v in range(4)], task_id=pid
+    )
+    distributed.finalize_distributed_write(part_dir)
+
     print(
         json.dumps(
             {
@@ -98,6 +141,8 @@ def main() -> None:
                 "local_rows": int(hb["uid"].shape[0]),
                 "marker_before": marker_before,
                 "marker_after": marker_after,
+                "resume_ok": resume_ok,
+                "host_rows_total": len(full),
             }
         )
     )

@@ -71,17 +71,16 @@ class _FaultyFile:
         return self._inner.write(data)
 
     def close(self):
-        try:
-            if self._plan.close_faults and not self._inner.closed and \
-                    "w" in getattr(self._inner, "mode", "w"):
-                if any(k in self._path for k in self._plan.close_faults):
-                    # the backend buffer is dropped, mirroring a failed
-                    # object-store upload: nothing becomes visible
-                    self._inner.close()
-                    raise OSError(f"injected upload failure on close: {self._path}")
-        finally:
-            if not self._inner.closed:
-                self._inner.close()
+        if self._plan.close_faults and not self._inner.closed and \
+                "w" in getattr(self._inner, "mode", "w"):
+            if any(k in self._path for k in self._plan.close_faults):
+                # a LOST upload: the inner file is never closed, so the
+                # fsspec buffer is never committed to the store — the
+                # object does not exist afterwards (the real object-store
+                # failure mode; abort must cope with a missing file)
+                raise OSError(f"injected upload failure on close: {self._path}")
+        if not self._inner.closed:
+            self._inner.close()
 
     @property
     def closed(self):
@@ -217,15 +216,32 @@ class TestRemoteWriteFaults:
         tfio.write(ROWS[:10], SCHEMA, out, mode="error")
         assert sorted(tfio.read(out, schema=SCHEMA).column("id")) == list(range(10))
 
-    def test_success_marker_close_failure_propagates(self, mem_url, faulty_fs):
-        """Even the _SUCCESS marker upload failing must not report success."""
+    def test_upload_failure_leaves_no_object_behind(self, mem_url, faulty_fs):
+        """The injected close() failure models a LOST upload: the temp part
+        file must not exist on the store afterwards (abort must cope with
+        deleting files that never materialized)."""
+        out = mem_url + "/lost"
+        faulty_fs.close_faults = {"part-"}
+        with pytest.raises(OSError, match="injected upload failure"):
+            tfio.write(ROWS[:5], SCHEMA, out, mode="error")
+        mem = fsspec.filesystem("memory")
+        key = out.split("://", 1)[1]
+        if mem.exists(key):
+            found = [p for p in mem.find(key) if "part-" in p]
+            assert found == [], found
+
+    def test_success_marker_write_failure_propagates(self, mem_url, monkeypatch):
+        """The _SUCCESS marker is created via FsspecFS.touch (not open):
+        a failed marker upload must surface, never report success."""
         out = mem_url + "/marker"
-        faulty_fs.close_faults = {"_SUCCESS"}
-        try:
+        orig_touch = tfs.FsspecFS.touch
+
+        def touch_(self, path):
+            if "_SUCCESS" in path:
+                raise OSError(f"injected marker upload failure: {path}")
+            return orig_touch(self, path)
+
+        monkeypatch.setattr(tfs.FsspecFS, "touch", touch_)
+        with pytest.raises(OSError, match="injected marker upload"):
             tfio.write(ROWS[:4], SCHEMA, out, mode="error")
-            wrote_ok = True
-        except OSError:
-            wrote_ok = False
-        if wrote_ok:
-            # acceptable only if the marker actually became visible
-            assert tfio.has_success_marker(out)
+        assert not tfio.has_success_marker(out)

@@ -39,21 +39,9 @@ def _corruption(msg: str) -> Exception:
 
 
 def _read_exact(fh, n: int) -> bytes:
-    """Read exactly n bytes, looping over short reads (remote/object-store
-    streams may legally return fewer bytes per call than asked; only a
-    0-byte read is EOF)."""
-    data = fh.read(n)
-    if len(data) in (0, n):
-        return data
-    parts = [data]
-    got = len(data)
-    while got < n:
-        more = fh.read(n - got)
-        if not more:
-            break
-        parts.append(more)
-        got += len(more)
-    return b"".join(parts)
+    from tpu_tfrecord.wire import read_exact  # lazy: avoids an import cycle
+
+    return read_exact(fh, n)
 
 
 # ---------------------------------------------------------------------------

@@ -118,6 +118,15 @@ def finalize_distributed_write(output_path: str) -> None:
         multihost_utils.sync_global_devices(f"tfr_write_done:{output_path}")
 
 
+def barrier(name: str) -> None:
+    """Cross-process barrier (no-op single-process). Used e.g. to publish a
+    dataset written by one host before the others read it."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"tfr_barrier:{name}")
+
+
 def assert_same_across_hosts(value: bytes, what: str = "value") -> None:
     """Cheap cross-host consistency check (e.g. schema JSON, shard-list
     digest) — catches divergent host state before it corrupts a run."""

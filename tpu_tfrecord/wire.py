@@ -494,6 +494,25 @@ class RecordWriter:
         self._fh.flush()
 
 
+def read_exact(fh, n: int) -> bytes:
+    """Read exactly n bytes, looping over short reads: remote/object-store
+    streams may legally return fewer bytes per call than asked — only a
+    0-byte read is EOF, and only EOF mid-record is truncation. Shared by
+    every framing reader (RecordReader here, HadoopBlockFile)."""
+    data = fh.read(n)
+    if len(data) in (0, n):
+        return data
+    parts = [data]
+    got = len(data)
+    while got < n:
+        more = fh.read(n - got)
+        if not more:
+            break
+        parts.append(more)
+        got += len(more)
+    return b"".join(parts)
+
+
 class RecordReader:
     """Streaming TFRecord reader over a binary file object.
 
@@ -507,26 +526,9 @@ class RecordReader:
         self.records_read = 0
         self.bytes_read = 0
 
-    def _read_exact(self, n: int) -> bytes:
-        """Read exactly n bytes, looping over short reads: remote/object-
-        store streams may legally return fewer bytes per call than asked —
-        only a 0-byte read is EOF, and only EOF mid-record is truncation."""
-        data = self._fh.read(n)
-        if len(data) in (0, n):
-            return data
-        parts = [data]
-        got = len(data)
-        while got < n:
-            more = self._fh.read(n - got)
-            if not more:
-                break
-            parts.append(more)
-            got += len(more)
-        return b"".join(parts)
-
     def read(self) -> Optional[bytes]:
         """Read one record; returns None at a clean EOF."""
-        header = self._read_exact(HEADER_BYTES)
+        header = read_exact(self._fh, HEADER_BYTES)
         if len(header) == 0:
             return None
         if len(header) < HEADER_BYTES:
@@ -535,7 +537,7 @@ class RecordReader:
         (length_crc,) = _CRC_STRUCT.unpack_from(header, 8)
         if self._verify and masked_crc32c(header[:8]) != length_crc:
             raise TFRecordCorruptionError("corrupt TFRecord: bad length CRC")
-        body = self._read_exact(length + FOOTER_BYTES)
+        body = read_exact(self._fh, length + FOOTER_BYTES)
         if len(body) < length + FOOTER_BYTES:
             raise TFRecordCorruptionError("truncated TFRecord body")
         data = body[:length]
