@@ -38,10 +38,7 @@ def _corruption(msg: str) -> Exception:
     return TFRecordCorruptionError(msg)
 
 
-def _read_exact(fh, n: int) -> bytes:
-    from tpu_tfrecord.wire import read_exact  # lazy: avoids an import cycle
-
-    return read_exact(fh, n)
+from tpu_tfrecord.wire import read_exact as _read_exact  # noqa: E402
 
 
 # ---------------------------------------------------------------------------

@@ -28,9 +28,11 @@ training step either way. The selection-matmul formulation does
 ~F/2 x the Gram FLOPs (two [F,P] one-hot contractions vs one [F,F] Gram),
 so it LOSES to XLA at F >= 64 even though the P-tiled grid keeps VMEM
 bounded; auto-dispatch therefore uses Pallas only for F <= 32 and XLA's
-path otherwise. The kernel's structural value at small F is keeping the
+path otherwise. The kernel's primary value is STRUCTURAL: keeping the
 Gram block VMEM-resident (no [B,F,F] HBM round-trip) and serving as the
-fusion template for the interaction stack.
+in-repo template for fusion kernels (P-tiled grid, matmul-instead-of-
+gather, custom VJP). Run ``tools/pallas_device_time.py`` on a TPU for
+dispatch-free device-time numbers (PARITY.md "Pallas kernel" section).
 """
 
 from __future__ import annotations
