@@ -132,6 +132,14 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.tfr_hash_blob.argtypes = [
         ctypes.c_char_p, i64p, ctypes.c_int64, ctypes.c_int64, i64p
     ]
+    lib.tfr_snappy_decompress.restype = ctypes.c_int64
+    lib.tfr_snappy_decompress.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, u8p, ctypes.c_uint64
+    ]
+    lib.tfr_lz4_decompress.restype = ctypes.c_int64
+    lib.tfr_lz4_decompress.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, u8p, ctypes.c_uint64
+    ]
     lib.tfr_encode_batch.restype = ctypes.c_int64
     lib.tfr_encode_batch.argtypes = [
         ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
@@ -656,6 +664,75 @@ def hash_blob(blob: bytes, blob_offsets: np.ndarray, num_buckets: int) -> np.nda
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
     )
     return out
+
+
+# A valid snappy stream expands at most ~21x per compressed byte (a 3-byte
+# copy2 element emits up to 64 bytes); far beyond that, the length varint
+# is corrupt — refuse BEFORE allocating what untrusted bytes claim.
+_SNAPPY_MAX_EXPANSION = 100
+
+
+def snappy_decompress(data: bytes) -> Optional[bytes]:
+    """Native raw-snappy decode; None if the native lib is unavailable.
+    Raises ValueError / TFRecordCorruptionError on corrupt input."""
+    lib = load()
+    if lib is None:
+        return None
+    # parse the preamble with the shared (oracle) varint: its exact
+    # truncation/overflow errors, and one decoder to keep in sync
+    from tpu_tfrecord.hadoop_codecs import _read_varint
+
+    expected, _ = _read_varint(memoryview(data), 0)
+    if expected > _SNAPPY_MAX_EXPANSION * len(data) + 1024:
+        raise ValueError(
+            f"snappy: declared output {expected} is impossible for "
+            f"{len(data)} compressed bytes — corrupt length varint"
+        )
+    out = np.empty(expected, dtype=np.uint8)
+    rc = lib.tfr_snappy_decompress(
+        data, len(data),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), expected,
+    )
+    if rc < 0:
+        raise ValueError(f"corrupt snappy input (rc={rc})")
+    return out.tobytes()
+
+
+def lz4_decompress(
+    data: bytes,
+    expected: Optional[int] = None,
+    max_out: Optional[int] = None,
+) -> Optional[bytes]:
+    """Native lz4-block decode; None if the native lib is unavailable.
+    ``expected`` = exact output size (strictly enforced); ``max_out`` = an
+    upper bound (initial capacity only — e.g. the Hadoop block header's
+    remaining bytes). With neither, the buffer grows geometrically on
+    rc=-2."""
+    lib = load()
+    if lib is None:
+        return None
+    if expected is not None:
+        cap = expected
+    elif max_out is not None:
+        cap = max_out
+    else:
+        cap = max(4 * len(data) + 64, 1 << 16)
+    while True:
+        out = np.empty(cap, dtype=np.uint8)
+        rc = lib.tfr_lz4_decompress(
+            data, len(data),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap,
+        )
+        if rc >= 0:
+            if expected is not None and rc != expected:
+                raise ValueError(
+                    f"lz4: decoded {rc} bytes, framing promised {expected}"
+                )
+            return out[:rc].tobytes()
+        if rc == -2 and expected is None and max_out is None and cap < (1 << 31):
+            cap *= 4
+            continue
+        raise ValueError(f"corrupt lz4 input (rc={rc})")
 
 
 class NativeEncoder:

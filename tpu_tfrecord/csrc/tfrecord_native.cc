@@ -1729,6 +1729,135 @@ int64_t tfr_encode_batch(
 
 extern "C" {
 
+// ---------------------------------------------------------------------------
+// Hadoop-ecosystem block codecs: raw snappy + lz4 block decompression.
+// The Python fallbacks in hadoop_codecs.py are spec-complete but decode
+// element-dense (real-compressor) streams at tens of MB/s; these run at
+// memory speed. Contract: return decoded length, -1 on corrupt input,
+// -2 when dst_cap is too small. NEVER read/write out of bounds — these
+// functions face untrusted bytes (fuzz-tested).
+// ---------------------------------------------------------------------------
+
+// Raw snappy: preamble varint (uncompressed length), then tagged elements
+// (literals + 1/2/4-byte-offset copies; overlapping copies = RLE).
+int64_t tfr_snappy_decompress(const uint8_t* src, uint64_t n,
+                              uint8_t* dst, uint64_t dst_cap) {
+  const uint8_t* p = src;
+  const uint8_t* end = src + n;
+  uint64_t expected = 0;
+  int shift = 0;
+  for (;;) {
+    if (p >= end || shift > 35) return -1;
+    uint8_t b = *p++;
+    expected |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) break;
+    shift += 7;
+  }
+  if (expected > dst_cap) return -2;
+  uint8_t* d = dst;
+  uint8_t* dend = dst + expected;
+  while (p < end) {
+    uint8_t tag = *p++;
+    uint64_t len, offset;
+    switch (tag & 0x03) {
+      case 0: {  // literal
+        len = tag >> 2;
+        if (len >= 60) {
+          uint32_t extra = (uint32_t)len - 59;
+          if ((uint64_t)(end - p) < extra) return -1;
+          len = 0;
+          for (uint32_t i = 0; i < extra; i++) len |= (uint64_t)p[i] << (8 * i);
+          p += extra;
+        }
+        len += 1;
+        if ((uint64_t)(end - p) < len || (uint64_t)(dend - d) < len) return -1;
+        std::memcpy(d, p, len);
+        d += len;
+        p += len;
+        continue;
+      }
+      case 1:  // copy, 1-byte offset
+        if (p >= end) return -1;
+        len = ((tag >> 2) & 0x07) + 4;
+        offset = ((uint64_t)(tag >> 5) << 8) | *p++;
+        break;
+      case 2:  // copy, 2-byte offset
+        if (end - p < 2) return -1;
+        len = (tag >> 2) + 1;
+        offset = (uint64_t)p[0] | ((uint64_t)p[1] << 8);
+        p += 2;
+        break;
+      default:  // copy, 4-byte offset
+        if (end - p < 4) return -1;
+        len = (tag >> 2) + 1;
+        offset = (uint64_t)p[0] | ((uint64_t)p[1] << 8) |
+                 ((uint64_t)p[2] << 16) | ((uint64_t)p[3] << 24);
+        p += 4;
+        break;
+    }
+    if (offset == 0 || offset > (uint64_t)(d - dst)) return -1;
+    if ((uint64_t)(dend - d) < len) return -1;
+    const uint8_t* s = d - offset;
+    if (offset >= len) {
+      std::memcpy(d, s, len);
+      d += len;
+    } else {
+      for (uint64_t i = 0; i < len; i++) *d++ = s[i];  // RLE semantics
+    }
+  }
+  return (d == dend) ? (int64_t)expected : -1;
+}
+
+// LZ4 block: sequences of [token][lit-len ext][literals][offset LE16]
+// [match-len ext]; the final sequence is literals-only.
+int64_t tfr_lz4_decompress(const uint8_t* src, uint64_t n,
+                           uint8_t* dst, uint64_t dst_cap) {
+  const uint8_t* p = src;
+  const uint8_t* end = src + n;
+  uint8_t* d = dst;
+  uint8_t* dend = dst + dst_cap;
+  while (p < end) {
+    uint8_t token = *p++;
+    uint64_t lit = token >> 4;
+    if (lit == 15) {
+      for (;;) {
+        if (p >= end) return -1;
+        uint8_t b = *p++;
+        lit += b;
+        if (b != 255) break;
+      }
+    }
+    if ((uint64_t)(end - p) < lit) return -1;
+    if ((uint64_t)(dend - d) < lit) return -2;
+    std::memcpy(d, p, lit);
+    d += lit;
+    p += lit;
+    if (p >= end) break;  // final literals-only sequence
+    if (end - p < 2) return -1;
+    uint64_t offset = (uint64_t)p[0] | ((uint64_t)p[1] << 8);
+    p += 2;
+    if (offset == 0 || offset > (uint64_t)(d - dst)) return -1;
+    uint64_t mlen = (token & 0x0F) + 4;
+    if ((token & 0x0F) == 15) {
+      for (;;) {
+        if (p >= end) return -1;
+        uint8_t b = *p++;
+        mlen += b;
+        if (b != 255) break;
+      }
+    }
+    if ((uint64_t)(dend - d) < mlen) return -2;
+    const uint8_t* s = d - offset;
+    if (offset >= mlen) {
+      std::memcpy(d, s, mlen);
+      d += mlen;
+    } else {
+      for (uint64_t i = 0; i < mlen; i++) *d++ = s[i];
+    }
+  }
+  return (int64_t)(d - dst);
+}
+
 // CRC32C-hash each value in a blob into [0, num_buckets). The categorical
 // string -> embedding-row path: strings never reach Python objects or the
 // TPU; one call hashes a whole column.

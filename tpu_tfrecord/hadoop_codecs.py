@@ -88,13 +88,30 @@ def snappy_decompress(data: bytes) -> bytes:
     """Decode one raw-snappy buffer (the format inside Hadoop's block
     framing). Full spec: literal elements and 1/2/4-byte-offset copies,
     including overlapping copies (offset < length, byte-at-a-time RLE
-    semantics)."""
+    semantics). Dispatch: in-repo native decoder (memory-speed) ->
+    python-snappy if installed -> the pure-Python reference below."""
+    try:
+        from tpu_tfrecord import _native
+
+        if _native.available():
+            out = _native.snappy_decompress(data)
+            if out is not None:
+                return out
+    except ValueError as e:
+        raise _corruption(f"snappy: {e}") from e
+    except ImportError:
+        pass
     lib = _snappy_lib()
     if lib is not None:
         try:
             return lib.uncompress(data)
         except Exception as e:
             raise _corruption(f"snappy: {e}") from e
+    return _snappy_decompress_py(data)
+
+
+def _snappy_decompress_py(data: bytes) -> bytes:
+    """Pure-Python reference decoder (also the oracle for the native one)."""
     buf = memoryview(data)
     expected, pos = _read_varint(buf, 0)
     out = bytearray()
@@ -179,10 +196,34 @@ def snappy_compress(data: bytes) -> bytes:
 # ---------------------------------------------------------------------------
 
 
-def lz4_decompress(data: bytes, expected: Optional[int] = None) -> bytes:
+def lz4_decompress(
+    data: bytes,
+    expected: Optional[int] = None,
+    max_out: Optional[int] = None,
+) -> bytes:
     """Decode one lz4 BLOCK (the format inside Hadoop's Lz4Codec framing):
     sequences of [token][literal-len ext][literals][offset LE16][match-len
-    ext]; the final sequence is literals-only."""
+    ext]; the final sequence is literals-only. Dispatch: in-repo native
+    decoder -> the pure-Python reference below. ``expected`` is enforced
+    exactly; ``max_out`` only sizes the native output buffer (the block
+    header's remaining bytes — avoids a decode-retry on high-ratio
+    chunks)."""
+    try:
+        from tpu_tfrecord import _native
+
+        if _native.available():
+            out = _native.lz4_decompress(data, expected, max_out)
+            if out is not None:
+                return out
+    except ValueError as e:
+        raise _corruption(f"lz4: {e}") from e
+    except ImportError:
+        pass
+    return _lz4_decompress_py(data, expected)
+
+
+def _lz4_decompress_py(data: bytes, expected: Optional[int] = None) -> bytes:
+    """Pure-Python reference decoder (also the oracle for the native one)."""
     buf = memoryview(data)
     out = bytearray()
     pos = 0
@@ -281,6 +322,9 @@ class HadoopBlockFile(io.RawIOBase):
         self._path = path
         self._codec = codec
         self._compress, self._decompress = _RAW_CODECS[codec]
+        # lz4 chunks carry no own output-size header; the block header's
+        # remaining byte count sizes the native decode buffer exactly
+        self._pass_bound = codec == "lz4"
         if "w" in mode:
             self._raw = fileobj if fileobj is not None else open(path, "wb")
             self._writing = True
@@ -328,7 +372,10 @@ class HadoopBlockFile(io.RawIOBase):
                     f"truncated {self._codec} stream in {self._path}: "
                     "EOF inside a chunk"
                 )
-            plain = self._decompress(chunk)
+            if self._pass_bound:
+                plain = self._decompress(chunk, max_out=uncomp_len - got)
+            else:
+                plain = self._decompress(chunk)
             got += len(plain)
             self._pending += plain
         if got != uncomp_len:
