@@ -14,6 +14,7 @@ from tpu_tfrecord.tpu.mesh import (
     data_sharding,
     local_batch_size,
 )
+from tpu_tfrecord.tpu.bitpack import pack_bits, packed_width, unpack_bits
 from tpu_tfrecord.tpu.ingest import (
     DeviceIterator,
     HostPrefetcher,
@@ -36,4 +37,7 @@ __all__ = [
     "hash_bytes_column",
     "DeviceIterator",
     "HostPrefetcher",
+    "pack_bits",
+    "packed_width",
+    "unpack_bits",
 ]
