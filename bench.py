@@ -290,9 +290,9 @@ def main() -> None:
     # the windows reports the link-shaped sustained rate (`sustained_value`).
     n_windows = max(1, int(os.environ.get("TFR_BENCH_WINDOWS", 4)))
     window_seconds = MEASURE_SECONDS / n_windows
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from tpu_tfrecord.tpu import data_sharding
 
-    sharding = NamedSharding(mesh, P("data", None))
+    sharding = data_sharding(mesh, ndim=2)
     duty = DutyCycle()
     windows = []
     # On a single-core host the background-thread machinery (HostPrefetcher
@@ -336,6 +336,7 @@ def main() -> None:
                 if t_end - t_start >= window_seconds:
                     break
             windows.append(examples / (t_end - t_start))
+        ingest_duty = duty.value() or 0.0  # windows only, not the sustain phase
         if SUSTAIN_SECONDS > 0:
             # keep hammering: the link's burst budget is long gone by the
             # end of this phase, so this is the shaped steady-state number
@@ -380,8 +381,9 @@ def main() -> None:
         # of wire-batch-sized fresh arrays, no pipeline) — the ceiling the
         # shaped tunnel granted THIS run
         "link_probe_mbps": round(link_probe_mbps, 1),
-        # transfer-hidden fraction of the ingest-only loop (phase 1)
-        "ingest_duty_cycle": round(duty.value() or 0.0, 4),
+        # transfer-hidden fraction of the ingest-only loop (phase 1,
+        # measurement windows only — the sustain phase is excluded)
+        "ingest_duty_cycle": round(ingest_duty, 4),
         # device-free pipeline throughput (decode+hash+pack, no device)
         "host_side_value": round(host_side_value, 1),
     }

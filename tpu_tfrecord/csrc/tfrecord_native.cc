@@ -354,35 +354,39 @@ struct ColBuilder {
     blob_offsets.push_back((int64_t)blob.size());
   }
 
-  // Undo the current record's (single) contribution to this column — the
-  // last mask entry plus whatever values/offsets it appended. Everything is
+  // Undo record ``r``'s (single) contribution to this column — clear its
+  // mask slot plus whatever values/offsets it appended. Everything is
   // derivable from the buffer tails, so duplicate-key last-wins semantics
-  // cost nothing on the happy path.
-  void rollback() {
-    if (mask.empty()) return;
-    mask.pop_back();
+  // cost nothing on the happy path. Only called after this record wrote to
+  // the column (dedup via seen_epoch, or the turbo slot walk), so the value
+  // tails are this record's; masks are positional (pre-filled 1), so the
+  // clear is an idempotent store.
+  void rollback(int64_t r) {
+    if ((size_t)r < mask.size()) mask[(size_t)r] = 0;
     if (group_buf) {
       // Zero the slot: if the duplicate's last occurrence turns out to be
       // missing (unset oneof), the documented missing->0 must hold — the
       // first occurrence's value may not survive.
       int itemsize = (dtype == DT_I64 || dtype == DT_F64) ? 8 : 4;
-      std::memset(group_buf + cur_row * group_stride + group_off, 0, itemsize);
+      std::memset(group_buf + r * group_stride + group_off, 0, itemsize);
       return;
     }
     if (layout == LAYOUT_SCALAR) {
       if (dtype == DT_BYTES) {
+        if (blob_offsets.size() < 2) return;
         blob_offsets.pop_back();
         blob.resize((size_t)blob_offsets.back());
       } else {
         switch (dtype) {
-          case DT_I64: i64.pop_back(); break;
-          case DT_I32: i32.pop_back(); break;
-          case DT_F32: f32.pop_back(); break;
-          case DT_F64: f64.pop_back(); break;
+          case DT_I64: if (!i64.empty()) i64.pop_back(); break;
+          case DT_I32: if (!i32.empty()) i32.pop_back(); break;
+          case DT_F32: if (!f32.empty()) f32.pop_back(); break;
+          case DT_F64: if (!f64.empty()) f64.pop_back(); break;
         }
       }
       return;
     }
+    if (row_offsets.size() < 2) return;
     row_offsets.pop_back();
     int64_t prev = row_offsets.back();
     if (layout == LAYOUT_RAGGED) {
@@ -631,7 +635,7 @@ bool parse_features_map(const uint8_t* p, const uint8_t* end, const FieldMap& fi
       // map last-wins) or a feature_lists entry that appeared earlier in
       // the wire (context has priority either way) — roll back the previous
       // contribution, then re-append.
-      col.rollback();
+      col.rollback(epoch);
       seen_epoch[idx] = -1;  // unseen again until the re-append succeeds
       seen_fl_epoch[idx] = -1;  // any feature_lists claim is gone
     }
@@ -657,11 +661,11 @@ bool parse_features_map(const uint8_t* p, const uint8_t* end, const FieldMap& fi
           return false;
         }
       }
-      col.mask.push_back(1);
+      col.mask[(size_t)epoch] = 1;  // positional: rollback may have cleared it
     } else {
       col.value_count += n;
       col.row_offsets.push_back(col.value_count);
-      col.mask.push_back(1);
+      col.mask[(size_t)epoch] = 1;
     }
   }
   return true;
@@ -716,7 +720,7 @@ bool parse_feature_lists(const uint8_t* p, const uint8_t* end, const FieldMap& f
       // are last-wins (matching the Python oracle's dict overwrite) — roll
       // back the previous occurrence's contribution, then re-append, the
       // same contract as the context/features path above.
-      col.rollback();
+      col.rollback(epoch);
       seen_epoch[idx] = -1;  // unseen again until the re-append succeeds
       seen_fl_epoch[idx] = -1;
     }
@@ -784,7 +788,7 @@ bool parse_feature_lists(const uint8_t* p, const uint8_t* end, const FieldMap& f
       col.value_count += n_inner;
       col.row_offsets.push_back(col.value_count);
     }
-    col.mask.push_back(1);
+    col.mask[(size_t)epoch] = 1;  // positional: rollback may have cleared it
   }
   return true;
 }
@@ -822,12 +826,15 @@ struct TurboSlot {
 
 
 // Parse one record in turbo mode. Returns true on success (columns written,
-// caller sets seen_epoch); false = no harm done (partial writes rolled
-// back), caller re-parses generically. Slots are mutable: their adaptive
-// entry caches refresh as value shapes drift.
+// *out_written = number of distinct fields written — when it equals the
+// schema width the caller can skip ALL per-record bookkeeping); false = no
+// harm done (partial writes rolled back via the slot walk), caller
+// re-parses generically. Slots are mutable: their adaptive entry caches
+// refresh as value shapes drift.
 bool turbo_parse(const uint8_t* rp, const uint8_t* rend,
                  std::vector<TurboSlot>& slots,
-                 std::vector<ColBuilder>& cols, int32_t epoch) {
+                 std::vector<ColBuilder>& cols, int32_t epoch,
+                 int* out_written) {
   const uint8_t* p = rp;
   // Record must be exactly one top-level field: features map (tag 0x0A).
   if (p >= rend || *p != 0x0A) return false;
@@ -835,20 +842,27 @@ bool turbo_parse(const uint8_t* rp, const uint8_t* rend,
   uint64_t mlen;
   if (!turbo_read_varint(p, rend, &mlen)) return false;
   if ((uint64_t)(rend - p) != mlen) return false;
-  int written[256];
   int n_written = 0;
+  const size_t n_slots = slots.size();
+  size_t si = 0;
+  // Every completed slot with idx >= 0 wrote exactly one contribution (all
+  // abort sites precede the slot's value write), so rolling back the
+  // prefix of completed slots undoes the record without per-write
+  // bookkeeping on the happy path.
   auto abort_record = [&]() {
-    for (int i = 0; i < n_written; i++) cols[written[i]].rollback();
+    for (size_t j = 0; j < si; j++) {
+      if (slots[j].idx >= 0) cols[slots[j].idx].rollback(epoch);
+    }
     return false;
   };
-  for (TurboSlot& s : slots) {
+  for (; si < n_slots; si++) {
+    TurboSlot& s = slots[si];
     // --- cache-hit fast lane: one memcmp covers every tag and length ---
     if (s.entry_total && (uint64_t)(rend - p) >= s.entry_total &&
         std::memcmp(p, s.cache.data(), s.cache.size()) == 0) {
       const uint8_t* q = p + s.cache.size();
       p += s.entry_total;
       if (s.idx < 0) continue;
-      if (n_written >= 256) return abort_record();
       ColBuilder& col = cols[s.idx];
       col.cur_row = epoch;
       if (col.kind == KIND_INT64) {
@@ -877,8 +891,7 @@ bool turbo_parse(const uint8_t* rp, const uint8_t* rend,
         std::memcpy(&v, q, 4);
         col.push_f32(v);
       }
-      col.mask.push_back(1);
-      written[n_written++] = s.idx;
+      n_written++;  // mask slot is pre-filled 1
       continue;
     }
     // --- field-wise lane (cache miss): parse tags, refresh the cache ---
@@ -902,7 +915,6 @@ bool turbo_parse(const uint8_t* rp, const uint8_t* rend,
       }
       continue;
     }
-    if (n_written >= 256) return abort_record();  // absurd width: stay correct
     ColBuilder& col = cols[s.idx];
     // map-entry value: Feature (field 2) filling the rest of the entry
     if (q >= ee || *q != 0x12) return abort_record();
@@ -996,15 +1008,15 @@ bool turbo_parse(const uint8_t* rp, const uint8_t* rend,
       s.entry_total = (uint32_t)(ee - p0);
       s.value_len = vlen;
     }
-    col.mask.push_back(1);
-    written[n_written++] = s.idx;
+    n_written++;  // mask slot is pre-filled 1
   }
   if (p != rend) return abort_record();  // extra entries -> generic
+  *out_written = n_written;
   return true;
 }
 
-void append_missing(ColBuilder& col) {
-  col.mask.push_back(0);
+void append_missing(ColBuilder& col, int64_t r) {
+  if ((size_t)r < col.mask.size()) col.mask[(size_t)r] = 0;
   if (col.group_buf) return;  // group matrix is zero-initialized
   if (col.layout == LAYOUT_SCALAR) {
     switch (col.dtype) {
@@ -1135,8 +1147,10 @@ void init_decode_state(DecodeState& st, int64_t n_records_hint,
     }
     col.init_offsets();
     st.fields.emplace(col.name, i);
-    // Pre-size the common buffers for the batch.
-    col.mask.reserve(n_records_hint);
+    // Positional mask, pre-filled "present": success paths never touch it
+    // (the hot case), missing/rollback clear their record's slot. Sized to
+    // the hint; the fused path shrinks it to the decoded count afterwards.
+    col.mask.assign((size_t)n_records_hint, 1);
     if (col.layout != LAYOUT_SCALAR) col.row_offsets.reserve(n_records_hint + 1);
     if (col.group_buf) continue;  // values live in the group matrix
     if (col.dtype == DT_BYTES) {
@@ -1169,8 +1183,14 @@ bool decode_one(DecodeState& st, const uint8_t* rp, uint64_t rlen, int64_t r,
   BatchResult* res = st.res;
   const int32_t n_fields = st.n_fields;
   if (r) { st.sticky_features.next_record(); st.sticky_lists.next_record(); }
+  int turbo_written = 0;
   if (st.turbo_ready &&
-      turbo_parse(rp, rp + rlen, st.turbo_slots, res->cols, (int32_t)r)) {
+      turbo_parse(rp, rp + rlen, st.turbo_slots, res->cols, (int32_t)r,
+                  &turbo_written)) {
+    // All fields written: nothing can be missing, and seen_epoch updates
+    // are unobservable (later records compare against THEIR index, and
+    // record indices never repeat) — skip all per-record bookkeeping.
+    if (turbo_written == n_fields) return true;
     for (const TurboSlot& s : st.turbo_slots) {
       if (s.idx >= 0) st.seen_epoch[s.idx] = (int32_t)r;
     }
@@ -1182,7 +1202,7 @@ bool decode_one(DecodeState& st, const uint8_t* rp, uint64_t rlen, int64_t r,
                          " does not allow null values").c_str());
           return false;
         }
-        append_missing(res->cols[i]);
+        append_missing(res->cols[i], r);
       }
     }
     return true;
@@ -1217,7 +1237,7 @@ bool decode_one(DecodeState& st, const uint8_t* rp, uint64_t rlen, int64_t r,
           ok = false;
           break;
         }
-        append_missing(res->cols[i]);
+        append_missing(res->cols[i], r);
       }
     }
   }
@@ -1358,10 +1378,12 @@ void* tfr_scan_decode(const uint8_t* buf, uint64_t len, uint64_t start,
     decoded++;
     *consumed = pos;
   }
-  // Group matrices were sized for max_records; shrink to what decoded.
+  // Group matrices and masks were sized for max_records; shrink to what
+  // decoded.
   for (size_t g = 0; g < st.res->group_bufs.size(); g++) {
     st.res->group_bufs[g].resize((size_t)decoded * group_strides[g]);
   }
+  for (auto& col : st.res->cols) col.mask.resize((size_t)decoded);
   *n_skipped = skipped;
   *n_decoded = decoded;
   return st.res;
