@@ -264,7 +264,7 @@ def main() -> None:
 
     it = ds.batches()
 
-    from tpu_tfrecord.tpu import pack_bits, packed_width
+    from tpu_tfrecord.tpu import pack_mixed, packed_width
 
     link_bytes = 4 * (14 + packed_width(26, CAT_BITS))
 
@@ -278,10 +278,7 @@ def main() -> None:
             hb = host_batch_from_columnar(
                 cb, ds.schema, hash_buckets=hash_buckets, pack=pack
             )
-            m = hb["packed"]
-            yield np.concatenate(
-                [m[:, :14], pack_bits(m[:, 14:], CAT_BITS)], axis=1
-            )
+            yield pack_mixed(hb["packed"], 14, CAT_BITS)
 
     # This is a SHARED box: other tenants' load swings any single window by
     # +-25%. Measure N windows back-to-back within one run and report the

@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tpu_tfrecord.tpu.bitpack import pack_bits, packed_width, unpack_bits
+from tpu_tfrecord.tpu.bitpack import pack_bits, pack_mixed, packed_width, unpack_bits
 
 
 @pytest.mark.parametrize("bits", [1, 3, 7, 13, 20, 24, 31, 32])
@@ -76,6 +76,57 @@ def test_unpack_under_sharding():
     gb = jax.device_put(packed, NamedSharding(mesh, P("data", None)))
     out = jax.jit(lambda p: unpack_bits(p, 26, 20))(gb)
     np.testing.assert_array_equal(np.asarray(out), vals.astype(np.int32))
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.int64])
+@pytest.mark.parametrize("bits", [1, 7, 20, 31, 32])
+@pytest.mark.parametrize("keep,c", [(0, 26), (14, 26), (3, 1), (5, 0)])
+def test_pack_mixed_equals_reference(dtype, bits, keep, c):
+    """pack_mixed == concat + pack_bits; int32 input takes the native
+    kernel (when built), int64 the numpy fallback — both bit-identical."""
+    rng = np.random.default_rng(bits + keep)
+    arr = np.concatenate(
+        [
+            rng.integers(0, 1 << 31, size=(37, keep)),
+            rng.integers(0, min(1 << bits, 1 << 31), size=(37, c)),
+        ],
+        axis=1,
+    ).astype(dtype)
+    got = pack_mixed(arr, keep, bits)
+    ref = np.concatenate(
+        [arr[:, :keep].astype(np.int32), pack_bits(arr[:, keep:].astype(np.int64), bits)],
+        axis=1,
+    )
+    np.testing.assert_array_equal(got, ref)
+    # and the round trip through the device-side unpack
+    if c:
+        out = np.asarray(unpack_bits(got[:, keep:], c, bits))
+        np.testing.assert_array_equal(
+            out, (arr[:, keep:].astype(np.int64) & ((1 << bits) - 1)).astype(np.int32)
+        )
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.int64])
+def test_pack_mixed_rejects_bad_args(dtype):
+    arr = np.zeros((4, 6), dtype=dtype)
+    with pytest.raises(ValueError, match="keep"):
+        pack_mixed(arr, 7, 20)
+    with pytest.raises(ValueError, match="non-negative"):
+        # negative in a PACKED column — caught by the kernel's packing pass
+        # (int32/native) or the fallback's scan (int64/numpy)
+        bad = np.zeros((2, 3), dtype=dtype)
+        bad[1, 2] = -1
+        pack_mixed(bad, 1, 20)
+    with pytest.raises(ValueError, match=r"\[B, C\]"):
+        pack_mixed(np.zeros(3, dtype=np.int32), 0, 20)
+    with pytest.raises(ValueError, match="bits"):
+        pack_mixed(arr, 1, 0)  # validated before native dispatch
+    with pytest.raises(ValueError, match="bits"):
+        pack_mixed(arr, 1, 33)
+    # negative values in KEEP lanes are fine (verbatim int32 transfer lanes)
+    ok = np.full((2, 3), -7, dtype=dtype)
+    out = pack_mixed(ok, 3, 20)
+    np.testing.assert_array_equal(out, ok.astype(np.int32))
 
 
 def test_bench_style_mixed_layout():

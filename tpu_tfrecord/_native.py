@@ -132,6 +132,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.tfr_hash_blob.argtypes = [
         ctypes.c_char_p, i64p, ctypes.c_int64, ctypes.c_int64, i64p
     ]
+    lib.tfr_pack_mixed.restype = ctypes.c_int64
+    lib.tfr_pack_mixed.argtypes = [
+        i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, i32p,
+    ]
     lib.tfr_snappy_decompress.restype = ctypes.c_int64
     lib.tfr_snappy_decompress.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64, u8p, ctypes.c_uint64
@@ -663,6 +668,34 @@ def hash_blob(blob: bytes, blob_offsets: np.ndarray, num_buckets: int) -> np.nda
         num_buckets,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
     )
+    return out
+
+
+def pack_mixed(arr: np.ndarray, keep: int, bits: int) -> Optional[np.ndarray]:
+    """[B, C] int32 -> [B, keep + ceil((C-keep)*bits/32)] int32: first
+    ``keep`` lanes copied, the rest bit-packed (tpu/bitpack.py layout).
+    None if the native lib is unavailable (caller falls back to numpy);
+    raises ValueError on a negative packed value (sign check rides the
+    kernel's packing pass)."""
+    lib = load()
+    if lib is None:
+        return None
+    n_rows, n_cols = arr.shape
+    c = n_cols - keep
+    w = (c * bits + 31) // 32
+    src = np.ascontiguousarray(arr, dtype=np.int32)
+    out = np.empty((n_rows, keep + w), dtype=np.int32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    bad = lib.tfr_pack_mixed(
+        src.ctypes.data_as(i32p), n_rows, n_cols, keep, bits,
+        out.ctypes.data_as(i32p),
+    )
+    if bad >= 0:
+        r, j = divmod(int(bad), n_cols)
+        raise ValueError(
+            "pack_mixed requires non-negative values in packed columns "
+            f"(found {int(src[r, j])} at row {r}, column {j})"
+        )
     return out
 
 

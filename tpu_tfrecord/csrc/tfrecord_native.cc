@@ -1892,4 +1892,42 @@ void tfr_hash_blob(const uint8_t* blob, const int64_t* offsets, int64_t n,
   }
 }
 
+// Mixed-layout transfer packing (tpu/bitpack.py's hot path): copy the first
+// ``keep`` int32 lanes of each row verbatim, then bit-pack the remaining
+// ``n_cols - keep`` values into ``bits``-wide lanes, little-endian within
+// and across lanes (the exact layout pack_bits/unpack_bits define). ``out``
+// is [n_rows, keep + ceil((n_cols-keep)*bits/32)] int32, fully written
+// (trailing pad bits zeroed). Values are masked to ``bits``. Returns -1 on
+// success, or the flat index (row * n_cols + col) of the first NEGATIVE
+// packed value — sign validation rides the packing pass (a predictable
+// branch) instead of costing the wrapper a second full read.
+int64_t tfr_pack_mixed(const int32_t* in, int64_t n_rows, int32_t n_cols,
+                       int32_t keep, int32_t bits, int32_t* out) {
+  const int32_t c = n_cols - keep;
+  const int32_t w = (int32_t)(((int64_t)c * bits + 31) / 32);
+  const uint64_t vmask = bits >= 32 ? 0xFFFFFFFFull : ((1ull << bits) - 1);
+  for (int64_t r = 0; r < n_rows; r++) {
+    const int32_t* src = in + r * n_cols;
+    int32_t* dst = out + r * (keep + w);
+    std::memcpy(dst, src, (size_t)keep * 4);
+    uint64_t acc = 0;
+    int accbits = 0;
+    int32_t* o = dst + keep;
+    for (int32_t j = 0; j < c; j++) {
+      const int32_t v = src[keep + j];
+      if (v < 0) return r * n_cols + keep + j;
+      acc |= ((uint64_t)(uint32_t)v & vmask) << accbits;
+      accbits += bits;
+      if (accbits >= 32) {
+        *o++ = (int32_t)(uint32_t)acc;
+        acc >>= 32;
+        accbits -= 32;
+      }
+    }
+    if (accbits) *o++ = (int32_t)(uint32_t)acc;
+    while (o < dst + keep + w) *o++ = 0;
+  }
+  return -1;
+}
+
 }  // extern "C"

@@ -14,7 +14,7 @@ from tpu_tfrecord.tpu.mesh import (
     data_sharding,
     local_batch_size,
 )
-from tpu_tfrecord.tpu.bitpack import pack_bits, packed_width, unpack_bits
+from tpu_tfrecord.tpu.bitpack import pack_bits, pack_mixed, packed_width, unpack_bits
 from tpu_tfrecord.tpu.ingest import (
     DeviceIterator,
     HostPrefetcher,
@@ -38,6 +38,7 @@ __all__ = [
     "DeviceIterator",
     "HostPrefetcher",
     "pack_bits",
+    "pack_mixed",
     "packed_width",
     "unpack_bits",
 ]

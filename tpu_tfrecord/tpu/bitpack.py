@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["packed_width", "pack_bits", "unpack_bits"]
+__all__ = ["packed_width", "pack_bits", "pack_mixed", "unpack_bits"]
 
 _LANE = 32  # packing lane width: int32, the narrowest common transfer dtype
 
@@ -70,6 +70,41 @@ def pack_bits(arr: np.ndarray, bits: int) -> np.ndarray:
             out[:, lane + 1] |= vals[:, j] >> (bits - spill)
     # low 32 bits of each u64 lane are the packed stream
     return (out & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+
+
+def pack_mixed(arr: np.ndarray, keep: int, bits: int) -> np.ndarray:
+    """Mixed-width wire matrix: the first ``keep`` int32 lanes of each row
+    pass through verbatim, the remaining columns bit-pack to ``bits`` —
+    the one-call form of ``concatenate([arr[:, :keep], pack_bits(arr[:,
+    keep:], bits)])`` with a native single-pass kernel on the hot path
+    (csrc tfr_pack_mixed; numpy fallback is bit-identical, pinned in
+    tests/test_bitpack.py). The consumer unpacks the tail with
+    ``unpack_bits(wire[:, keep:], C - keep, bits)``.
+    """
+    if arr.ndim != 2:
+        raise ValueError(f"pack_mixed expects [B, C], got shape {arr.shape}")
+    if not 0 <= keep <= arr.shape[1]:
+        raise ValueError(f"keep={keep} out of range for {arr.shape[1]} columns")
+    packed_width(1, bits)  # validate bits BEFORE dispatching to the kernel
+    if arr.dtype == np.int32:
+        # hot path (decode emits int32 group matrices): single native pass,
+        # sign validation rides the kernel loop — no extra numpy scan
+        try:
+            from tpu_tfrecord import _native
+
+            if _native.available():
+                out = _native.pack_mixed(arr, keep, bits)
+                if out is not None:
+                    return out
+        except ImportError:
+            pass
+    tail = arr[:, keep:]
+    if np.issubdtype(tail.dtype, np.signedinteger) and tail.size and tail.min() < 0:
+        raise ValueError("pack_mixed requires non-negative values in packed columns")
+    return np.concatenate(
+        [np.ascontiguousarray(arr[:, :keep]).astype(np.int32), pack_bits(tail, bits)],
+        axis=1,
+    )
 
 
 def unpack_bits(packed, n_cols: int, bits: int):
