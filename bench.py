@@ -424,22 +424,28 @@ def _train_duty_cycle(ds, mesh, hash_buckets, pack, seconds=6.0):
     opt_state = tx.init(params)
     step = jax.jit(functools.partial(train_step, cfg=cfg, tx=tx), donate_argnums=(0, 1))
 
+    from tpu_tfrecord.tpu import pack_mixed, unpack_bits
+
     @jax.jit
     def split(gb):
-        packed = gb["packed"]
+        # consume the bit-packed wire form end-to-end: the 20-bit cat
+        # unpack fuses into THIS jit (train_step is a separate program —
+        # its donated params preclude merging here)
+        m = gb["wire"]
         return {
-            "label": packed[:, 0].astype(jnp.float32),
-            "dense": packed[:, 1:14].astype(jnp.float32),
-            "cat": packed[:, 14:40] % vocab,
+            "label": m[:, 0].astype(jnp.float32),
+            "dense": m[:, 1:14].astype(jnp.float32),
+            "cat": unpack_bits(m[:, 14:], 26, CAT_BITS) % vocab,
         }
 
     it = ds.batches()  # phase 1 closed its iterator; epochs are infinite
 
     def host_batches():
         for cb in it:
-            yield host_batch_from_columnar(
+            hb = host_batch_from_columnar(
                 cb, ds.schema, hash_buckets=hash_buckets, pack=pack
             )
+            yield {"wire": pack_mixed(hb["packed"], 14, CAT_BITS)}
 
     prefetcher = HostPrefetcher(host_batches())
     try:

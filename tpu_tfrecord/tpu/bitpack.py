@@ -98,11 +98,10 @@ def pack_mixed(arr: np.ndarray, keep: int, bits: int) -> np.ndarray:
                     return out
         except ImportError:
             pass
-    tail = arr[:, keep:]
-    if np.issubdtype(tail.dtype, np.signedinteger) and tail.size and tail.min() < 0:
-        raise ValueError("pack_mixed requires non-negative values in packed columns")
+    # pack_bits performs the negative-value rejection for the tail
     return np.concatenate(
-        [np.ascontiguousarray(arr[:, :keep]).astype(np.int32), pack_bits(tail, bits)],
+        [np.ascontiguousarray(arr[:, :keep]).astype(np.int32),
+         pack_bits(arr[:, keep:], bits)],
         axis=1,
     )
 
