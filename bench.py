@@ -181,6 +181,13 @@ def main() -> None:
 
     import jax
 
+    # Same defensive recipe as tests/conftest.py and the examples: with a
+    # dead device tunnel, backend discovery hangs regardless of the env
+    # var; the config path short-circuits to the named platform. (With no
+    # JAX_PLATFORMS set, the watchdog below still guards the TPU path.)
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     from tpu_tfrecord.tpu import (
         DeviceIterator,
         HostPrefetcher,
@@ -363,17 +370,21 @@ def main() -> None:
 
     # The link's shaping state is inherited from whatever ran before the
     # bench (PARITY.md "Device link"): a clamped first attempt measures the
-    # tunnel, not the pipeline. If the first attempt lands under the north
-    # star, rest the link once and re-measure; EVERY attempt is disclosed
-    # in the artifact (attempts[]), the headline is the best median.
+    # tunnel, not the pipeline. The retry trigger is the LINK probe, never
+    # the measured value — conditioning a retry on missing the target would
+    # bias the headline to max-of-draws (low outcomes re-rolled, high ones
+    # kept). A probe under the floor is direct evidence the shaper was
+    # engaged before the pipeline ran at all; rest the link once and
+    # re-measure. EVERY attempt is disclosed in the artifact (attempts[]);
+    # the headline is the attempt measured under the best link state.
     attempts = [measure_attempt()]
     retries = max(0, int(os.environ.get("TFR_BENCH_RETRIES", 1)))
     retry_rest = float(os.environ.get("TFR_BENCH_RETRY_REST", 150))
-    retry_below = float(os.environ.get("TFR_BENCH_RETRY_BELOW", 1_000_000))
-    while attempts[-1]["value"] < retry_below and len(attempts) <= retries:
+    link_floor = float(os.environ.get("TFR_BENCH_LINK_FLOOR_MBPS", 500))
+    while attempts[-1]["link_probe_mbps"] < link_floor and len(attempts) <= retries:
         time.sleep(retry_rest)
         attempts.append(measure_attempt(len(attempts)))
-    best = max(attempts, key=lambda a: a["value"])
+    best = max(attempts, key=lambda a: a["link_probe_mbps"])
     value = best["value"]
     windows = best["windows"]
     sustained_value = best["sustained_value"]
@@ -389,26 +400,28 @@ def main() -> None:
     if os.environ.get("TFR_BENCH_TRAIN", "1") != "0":
         train_duty = _train_duty_cycle(ds, mesh, hash_buckets, pack)
 
+    # Fields from `best` are already rounded/filtered by measure_attempt —
+    # formatting lives in ONE place.
     out = {
         "metric": "criteo_tf_example_ingest_to_device",
-        "value": round(value, 1),
+        "value": value,
         "unit": "examples/sec/host",
         "vs_baseline": round(value / 1_000_000, 4),
         # all measurement windows (median is the reported value)
-        "windows": [round(w, 1) for w in windows],
+        "windows": windows,
         # steady-state rate after the link's burst budget drains — on this
         # box that is the tunnel's token-bucket shaping (~130-250MB/s), not
         # the pipeline (see host_side_value and PARITY.md "Device link")
-        "sustained_value": round(sustained_value, 1) if sustained_value else None,
+        "sustained_value": sustained_value,
         # bytes/example on the link (cats bit-packed to 20-bit lanes)
         "link_bytes_per_example": link_bytes,
         # raw link bandwidth measured just before the windows (device_put
         # of wire-batch-sized fresh arrays, no pipeline) — the ceiling the
         # shaped tunnel granted THIS run
-        "link_probe_mbps": round(link_probe_mbps, 1),
+        "link_probe_mbps": link_probe_mbps,
         # transfer-hidden fraction of the ingest-only loop (phase 1,
         # measurement windows only — the sustain phase is excluded)
-        "ingest_duty_cycle": round(ingest_duty, 4),
+        "ingest_duty_cycle": ingest_duty,
         # device-free pipeline throughput (decode+hash+pack, no device)
         "host_side_value": round(host_side_value, 1),
     }
