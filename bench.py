@@ -181,12 +181,12 @@ def main() -> None:
 
     import jax
 
-    # Same defensive recipe as tests/conftest.py and the examples: with a
-    # dead device tunnel, backend discovery hangs regardless of the env
-    # var; the config path short-circuits to the named platform. (With no
-    # JAX_PLATFORMS set, the watchdog below still guards the TPU path.)
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import tpu_tfrecord
+
+    # With a dead device tunnel, backend discovery hangs regardless of the
+    # env var; see ensure_jax_platform. (With no JAX_PLATFORMS set, the
+    # watchdog below still guards the TPU path.)
+    tpu_tfrecord.ensure_jax_platform()
 
     from tpu_tfrecord.tpu import (
         DeviceIterator,

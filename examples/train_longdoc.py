@@ -25,13 +25,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
-# Honor JAX_PLATFORMS through jax.config too: this environment's
-# sitecustomize imports jax (registering a TPU plugin) BEFORE user code
-# runs, and when that plugin's device tunnel is dead backend discovery can
-# hang inside C regardless of the env var. The config path short-circuits
-# discovery to the named platform (same recipe as tests/conftest.py).
-if os.environ.get("JAX_PLATFORMS"):
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+import tpu_tfrecord
+
+# Without this, a dead device tunnel makes backend discovery hang even
+# under JAX_PLATFORMS=cpu — see ensure_jax_platform.
+tpu_tfrecord.ensure_jax_platform()
 
 import numpy as np
 import optax

@@ -7,8 +7,11 @@ import pytest
 from tpu_tfrecord.columnar import (
     ColumnarDecoder,
     bucket_boundaries,
+    concat_batches,
     pad_ragged,
     pad_ragged2,
+    slice_batch,
+    take_rows,
 )
 from tpu_tfrecord.options import RecordType
 from tpu_tfrecord.proto import Example, Feature, FeatureList, SequenceExample, encode_example, encode_sequence_example
@@ -197,3 +200,92 @@ class TestPadding:
         bounds = bucket_boundaries([1, 2, 3, 4, 100], num_buckets=2)
         assert bounds[-1] == 100
         assert len(bounds) >= 1
+
+
+class TestTakeRows:
+    """take_rows == per-row slice+concat (the oracle) on every layout."""
+
+    @staticmethod
+    def _assert_batches_equal(got, ref):
+        assert got.num_rows == ref.num_rows
+        assert set(got.columns) == set(ref.columns)
+        for name, g in got.columns.items():
+            r = ref.columns[name]
+            for attr in ("values", "offsets", "inner_offsets", "blob_offsets", "mask"):
+                a, b = getattr(g, attr), getattr(r, attr)
+                assert (a is None) == (b is None), (name, attr)
+                if a is not None:
+                    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            gb = None if g.blob is None else bytes(g.blob)
+            rb = None if r.blob is None else bytes(r.blob)
+            assert gb == rb, name
+
+    @staticmethod
+    def _example_batch():
+        schema = StructType(
+            [
+                StructField("a", LongType()),
+                StructField("s", StringType()),
+                StructField("v", ArrayType(FloatType())),
+            ]
+        )
+        rng = np.random.default_rng(0)
+        dec = ColumnarDecoder(schema, RecordType.EXAMPLE)
+        ser = TFRecordSerializer(schema)
+        rows = []
+        for i in range(97):
+            rows.append(
+                [
+                    None if i % 7 == 0 else i,
+                    None if i % 5 == 2 else f"s{i}" * (i % 3),
+                    None
+                    if i % 11 == 3
+                    else [float(x) for x in rng.normal(size=i % 4)],
+                ]
+            )
+        recs = [encode_row(ser, RecordType.EXAMPLE, r) for r in rows]
+        return dec.decode_batch(recs)
+
+    def test_permutation_matches_oracle(self):
+        batch = self._example_batch()
+        rng = np.random.default_rng(1)
+        idx = rng.permutation(batch.num_rows)
+        got = take_rows(batch, idx)
+        ref = concat_batches([slice_batch(batch, int(i), int(i) + 1) for i in idx])
+        self._assert_batches_equal(got, ref)
+
+    def test_repeats_and_subsets(self):
+        batch = self._example_batch()
+        rng = np.random.default_rng(2)
+        idx = rng.integers(0, batch.num_rows, size=250)
+        got = take_rows(batch, idx)
+        ref = concat_batches([slice_batch(batch, int(i), int(i) + 1) for i in idx])
+        self._assert_batches_equal(got, ref)
+
+    def test_ragged2_sequence_example(self):
+        schema = StructType([StructField("vv", ArrayType(ArrayType(LongType())))])
+        dec = ColumnarDecoder(schema, RecordType.SEQUENCE_EXAMPLE)
+        ser = TFRecordSerializer(schema)
+        rng = np.random.default_rng(3)
+        rows = [
+            [[[int(x) for x in rng.integers(0, 9, rng.integers(0, 4))] for _ in range(rng.integers(0, 3))]]
+            for _ in range(60)
+        ]
+        recs = [encode_row(ser, RecordType.SEQUENCE_EXAMPLE, r) for r in rows]
+        batch = dec.decode_batch(recs)
+        idx = rng.permutation(batch.num_rows)
+        got = take_rows(batch, idx)
+        ref = concat_batches([slice_batch(batch, int(i), int(i) + 1) for i in idx])
+        self._assert_batches_equal(got, ref)
+
+    def test_empty_indices_and_bounds(self):
+        batch = self._example_batch()
+        assert take_rows(batch, np.array([], dtype=np.int64)).num_rows == 0
+        with pytest.raises(IndexError):
+            take_rows(batch, [batch.num_rows])
+        with pytest.raises(IndexError):
+            take_rows(batch, [-1])
+        with pytest.raises(ValueError):
+            take_rows(batch, np.zeros((2, 2), dtype=np.int64))
+        with pytest.raises(TypeError, match="boolean mask"):
+            take_rows(batch, np.ones(batch.num_rows, dtype=bool))

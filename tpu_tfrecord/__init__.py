@@ -41,6 +41,32 @@ from tpu_tfrecord.registry import lookup_format, register_format
 
 __version__ = "0.1.0"
 
+
+def ensure_jax_platform() -> None:
+    """Mirror ``JAX_PLATFORMS`` into ``jax.config`` before first backend use.
+
+    Some environments import jax at interpreter start (sitecustomize),
+    registering accelerator plugins whose backend DISCOVERY can hang inside
+    C when the device link is dead — the env var's platform filter applies
+    too late to help. ``jax.config.update("jax_platforms", ...)``
+    short-circuits discovery to the named platform(s). One owner for the
+    recipe used by bench.py, the examples, and tests/conftest.py; call it
+    before any jax device/mesh call. No-op when JAX_PLATFORMS is unset or
+    jax is unavailable.
+    """
+    import os as _os
+
+    platforms = _os.environ.get("JAX_PLATFORMS")
+    if not platforms:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
+    except ImportError:
+        pass
+
+
 __all__ = [
     "ArrayType",
     "BinaryType",
@@ -58,5 +84,6 @@ __all__ = [
     "TFRecordOptions",
     "register_format",
     "lookup_format",
+    "ensure_jax_platform",
     "__version__",
 ]

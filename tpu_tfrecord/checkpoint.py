@@ -16,6 +16,12 @@ from typing import Optional
 from tpu_tfrecord.io.dataset import CheckpointableIterator, IteratorState
 
 _FORMAT_VERSION = 1
+# Version 2: the state carries ``window_emitted`` (mid-window position of a
+# row-shuffled iterator). Semantically load-bearing — an old reader that
+# dropped the field would resume at the window start and replay batches —
+# so such states are WRITTEN as version 2, which old readers refuse cleanly.
+_FORMAT_VERSION_WINDOWED = 2
+_READABLE_VERSIONS = (1, 2)
 
 
 def state_path(directory: str, process_index: Optional[int] = None) -> str:
@@ -42,14 +48,19 @@ def _extract_state(state_or_iterator) -> IteratorState:
 
 
 def _make_payload(state: IteratorState, step: Optional[int] = None) -> dict:
-    payload = {"version": _FORMAT_VERSION, "state": state.to_json()}
+    version = (
+        _FORMAT_VERSION_WINDOWED
+        if getattr(state, "window_emitted", 0)
+        else _FORMAT_VERSION
+    )
+    payload = {"version": version, "state": state.to_json()}
     if step is not None:
         payload["step"] = step
     return payload
 
 
 def _check_version(payload: dict, where: str) -> None:
-    if payload.get("version") != _FORMAT_VERSION:
+    if payload.get("version") not in _READABLE_VERSIONS:
         raise ValueError(
             f"unsupported input-state version {payload.get('version')} {where}"
         )

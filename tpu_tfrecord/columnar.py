@@ -500,6 +500,91 @@ def concat_batches(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
     return ColumnarBatch(out, sum(b.num_rows for b in batches))
 
 
+def _span_gather(offsets: np.ndarray, idx: np.ndarray):
+    """Vectorized variable-span gather plan: for span ids ``idx`` over an
+    ``offsets`` array, return (flat_element_indices, new_offsets) such that
+    elements[flat] laid out contiguously realize spans idx[0], idx[1], ...
+    with boundaries new_offsets."""
+    offsets = np.asarray(offsets)
+    lengths = np.diff(offsets)[idx]
+    new_offsets = np.empty(len(idx) + 1, dtype=np.int64)
+    new_offsets[0] = 0
+    np.cumsum(lengths, out=new_offsets[1:])
+    total = int(new_offsets[-1])
+    starts = offsets[idx]
+    # element j of output = starts[span(j)] + (j - new_offsets[span(j)])
+    flat = (
+        np.repeat(starts, lengths)
+        + np.arange(total, dtype=np.int64)
+        - np.repeat(new_offsets[:-1], lengths)
+    )
+    return flat, new_offsets
+
+
+def _gather_blob(col: Column, new: Column, value_idx: np.ndarray) -> None:
+    """Rebuild blob/blob_offsets for values at ``value_idx`` (in order)."""
+    bflat, new_bo = _span_gather(col.blob_offsets, value_idx)
+    blob_arr = np.frombuffer(col.blob, dtype=np.uint8)
+    new.blob = blob_arr[bflat].tobytes()
+    new.blob_offsets = new_bo
+
+
+def take_rows(batch: ColumnarBatch, indices) -> ColumnarBatch:
+    """Row gather: a new batch whose row i is ``batch`` row ``indices[i]``.
+
+    The in-memory shuffle primitive (windowed row shuffle, subsampling,
+    sorting): one vectorized pass per column, every layout — scalar, ragged,
+    ragged^2, bytes-like, hash-fused, group matrices — handled with the
+    same span-gather plan. Oracle-pinned against per-row slice+concat in
+    tests/test_columnar.py."""
+    raw = np.asarray(indices)
+    if raw.dtype == np.bool_:
+        # a validity mask would silently cast to 1/0 gather indices —
+        # demand explicit positions (np.nonzero(mask)[0] for a mask-select)
+        raise TypeError(
+            "take_rows takes integer row positions, not a boolean mask; "
+            "use np.nonzero(mask)[0]"
+        )
+    idx = raw.astype(np.int64, copy=False)
+    if idx.ndim != 1:
+        raise ValueError(f"take_rows expects 1-D indices, got shape {idx.shape}")
+    if idx.size and (idx.min() < 0 or idx.max() >= batch.num_rows):
+        raise IndexError(
+            f"take_rows indices out of range for {batch.num_rows} rows"
+        )
+    out: Dict[str, Column] = {}
+    for name, col in batch.columns.items():
+        new = Column(
+            name,
+            col.dtype,
+            mask=col.mask[idx] if col.mask is not None else None,
+            hash_buckets=col.hash_buckets,
+        )
+        if col.inner_offsets is not None:  # ragged2: rows -> inner lists -> values
+            inner_idx, new_off = _span_gather(col.offsets, idx)
+            vflat, new_inner = _span_gather(col.inner_offsets, inner_idx)
+            new.offsets = new_off
+            new.inner_offsets = new_inner
+            if col.values is not None:
+                new.values = np.asarray(col.values)[vflat]
+            if col.blob is not None:
+                _gather_blob(col, new, vflat)
+        elif col.offsets is not None:  # ragged: rows -> values
+            vflat, new_off = _span_gather(col.offsets, idx)
+            new.offsets = new_off
+            if col.values is not None:
+                new.values = np.asarray(col.values)[vflat]
+            if col.blob is not None:
+                _gather_blob(col, new, vflat)
+        else:  # scalar (1-D values, or a [N, K] group matrix)
+            if col.values is not None:
+                new.values = np.asarray(col.values)[idx]
+            if col.blob is not None:
+                _gather_blob(col, new, idx)
+        out[name] = new
+    return ColumnarBatch(out, len(idx))
+
+
 def _concat_offsets(offset_arrays: List[np.ndarray]) -> np.ndarray:
     total = sum(len(o) - 1 for o in offset_arrays)
     out = np.empty(total + 1, dtype=np.int64)

@@ -35,6 +35,7 @@ from tpu_tfrecord.columnar import (
     ColumnarDecoder,
     concat_batches,
     slice_batch,
+    take_rows,
 )
 from tpu_tfrecord.io import paths as p
 from tpu_tfrecord.io.reader import DatasetReader
@@ -60,12 +61,19 @@ class IteratorState:
     against a changed dataset raises loudly instead of silently reading
     wrong or duplicate data. None (e.g. states from older checkpoints) skips
     the check. Excluded from equality — two states at the same position are
-    the same position."""
+    the same position.
+
+    With windowed row shuffling (``shuffle_window``), a position inside a
+    window points at the WINDOW START and ``window_emitted`` counts batches
+    already yielded from it: resume re-decodes the window from the stored
+    position, re-derives the same permutation (seeded by the start
+    position), and skips the emitted batches — state stays O(1)."""
 
     epoch: int = 0
     shard_cursor: int = 0
     record_offset: int = 0
     fingerprint: Optional[str] = field(default=None, compare=False)
+    window_emitted: int = 0
 
     def to_json(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -75,6 +83,8 @@ class IteratorState:
         }
         if self.fingerprint is not None:
             out["fingerprint"] = self.fingerprint
+        if self.window_emitted:
+            out["window_emitted"] = self.window_emitted
         return out
 
     @staticmethod
@@ -108,6 +118,7 @@ class TFRecordDataset:
         prefetch: int = 2,
         num_workers: int = 1,
         shuffle: bool = False,
+        shuffle_window: int = 0,
         seed: int = 0,
         read_retries: int = 0,
         hash_buckets: Optional[Dict[str, int]] = None,
@@ -161,6 +172,18 @@ class TFRecordDataset:
         self.num_workers = max(1, num_workers)
         self._scratch_local = threading.local()
         self.shuffle = shuffle
+        # Row-level shuffling: permute rows across windows of
+        # ``shuffle_window`` batches (0 = off). Deterministic (seeded by the
+        # window's start position) and resumable in O(1) state — see
+        # IteratorState.window_emitted. Composes with shard-order
+        # ``shuffle`` for cross-shard mixing at two scales; TFRecord has no
+        # index (reference: isSplitable=false, DefaultSource.scala:26-29),
+        # so a GLOBAL row permutation is impossible without a sidecar —
+        # windowed shuffle is the streaming-format-native equivalent of
+        # tf.data's shuffle buffer, made deterministic.
+        if shuffle_window < 0:
+            raise ValueError(f"shuffle_window must be >= 0, got {shuffle_window}")
+        self.shuffle_window = shuffle_window
         self.seed = seed
         self.read_retries = read_retries
         self.slab_bytes = max(1, slab_bytes)
@@ -551,6 +574,15 @@ class TFRecordDataset:
                 "seed": self.seed,
                 "record_type": self.options.record_type.value,
             }
+            if self.shuffle_window:
+                # only stamped when in use: states from row-shuffled
+                # iterators must not resume under a different window size
+                # (or none), and vice versa; absent for shuffle_window=0 so
+                # existing unshuffled states stay valid. batch_size joins
+                # because window_emitted counts BATCHES — a different batch
+                # size makes the same count a different number of rows.
+                ident["shuffle_window"] = self.shuffle_window
+                ident["batch_size"] = self.batch_size
             blob = json.dumps(ident, sort_keys=True).encode()
             self._fingerprint = hashlib.sha256(blob).hexdigest()[:32]
         return self._fingerprint
@@ -619,6 +651,9 @@ def _producer_loop(
                 continue
         return False
 
+    if ds.shuffle_window:
+        _shuffled_producer_loop(ds, start, out_queue, stop)
+        return
     try:
         # pending: [chunk, consumed_rows, epoch, cursor, chunk_start]
         pending: List[list] = []
@@ -636,6 +671,111 @@ def _producer_loop(
                 avail -= B
         if avail and not ds.drop_remainder:
             emit_from(pending, avail)
+        _put_until_stopped(out_queue, None, stop)
+    except BaseException as e:  # propagate to consumer
+        _put_until_stopped(out_queue, e, stop)
+
+
+def _window_permutation(seed: int, pos: IteratorState, n: int) -> np.ndarray:
+    """The deterministic row permutation for the window starting at ``pos``:
+    derived purely from (seed, start position), so a resume re-creates it
+    without any stored buffer state."""
+    ss = np.random.SeedSequence(
+        [seed & 0xFFFFFFFF, pos.epoch, pos.shard_cursor, pos.record_offset]
+    )
+    return np.random.default_rng(ss).permutation(n)
+
+
+def _shuffled_producer_loop(
+    ds: TFRecordDataset,
+    start: IteratorState,
+    out_queue: queue.Queue,
+    stop: threading.Event,
+) -> None:
+    """Windowed row shuffle: accumulate ``shuffle_window`` batches worth of
+    rows, permute them (seeded by the window's start position), emit
+    batch-size slices. Windows may span shards and epochs, exactly like
+    batches do in the unshuffled path.
+
+    Positions: every batch except a window's last carries the WINDOW START
+    plus ``window_emitted``; the last batch carries the position after the
+    window's end (so a checkpoint between windows needs no window replay).
+    """
+    B = ds.batch_size
+    target = ds.shuffle_window * B
+
+    def put(batch, pos) -> bool:
+        while not stop.is_set():
+            try:
+                out_queue.put((batch, pos), timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    try:
+        # Resume mid-window: rebuild from the stored window START; skip the
+        # batches the consumer already saw.
+        emit_skip = start.window_emitted
+        win_start = IteratorState(start.epoch, start.shard_cursor, start.record_offset)
+        win: List[ColumnarBatch] = []
+        rows = 0
+
+        def flush(end_pos: IteratorState, tail: bool) -> bool:
+            """Permute + emit the accumulated window; True to continue."""
+            nonlocal emit_skip, win, rows, win_start
+            if rows:
+                window = concat_batches(win) if len(win) > 1 else win[0]
+                perm = _window_permutation(ds.seed, win_start, rows)
+                n_batches = rows // B
+                if tail and rows % B and not ds.drop_remainder:
+                    n_batches += 1
+                for k in range(n_batches):
+                    if k < emit_skip:
+                        continue  # resume: skipped batches are never gathered
+                    # gather each emitted slice of the permutation directly:
+                    # one copy per batch instead of a whole-window gather
+                    # followed by per-batch slices
+                    piece = take_rows(window, perm[k * B : min((k + 1) * B, rows)])
+                    last = k == n_batches - 1
+                    pos = (
+                        end_pos
+                        if last
+                        else IteratorState(
+                            win_start.epoch,
+                            win_start.shard_cursor,
+                            win_start.record_offset,
+                            window_emitted=k + 1,
+                        )
+                    )
+                    if not put(piece, pos):
+                        return False
+            emit_skip = 0
+            win = []
+            rows = 0
+            win_start = end_pos
+            return True
+
+        stream_end = win_start  # position after the last consumed row
+        for chunk, epoch, cursor, chunk_start in ds._chunk_stream(win_start, stop):
+            if stop.is_set():
+                return
+            consumed = 0
+            while consumed < chunk.num_rows:
+                take = min(target - rows, chunk.num_rows - consumed)
+                if consumed == 0 and take == chunk.num_rows:
+                    win.append(chunk)  # aligned: no slice copy
+                else:
+                    win.append(slice_batch(chunk, consumed, consumed + take))
+                rows += take
+                consumed += take
+                stream_end = IteratorState(epoch, cursor, chunk_start + consumed)
+                if rows >= target:
+                    if not flush(stream_end, tail=False):
+                        return
+        # stream end: the final (short) window
+        if rows and not flush(stream_end, tail=True):
+            return
         _put_until_stopped(out_queue, None, stop)
     except BaseException as e:  # propagate to consumer
         _put_until_stopped(out_queue, e, stop)
