@@ -107,7 +107,10 @@ def main() -> None:
     resume = checkpoint.load_state(ckpt_dir)
     print("resuming from", resume) if resume else print("fresh start")
     ds = TFRecordDataset(
-        data_dir, batch_size=BATCH, schema=schema, num_epochs=2, shuffle=True, seed=0
+        data_dir, batch_size=BATCH, schema=schema, num_epochs=2,
+        # two-scale mixing: seeded shard-order shuffle + windowed row
+        # shuffle (rows permute across 8-batch windows; resume-exact)
+        shuffle=True, shuffle_window=8, seed=0
     )
     step = 0
     duty = DutyCycle()
