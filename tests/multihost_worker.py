@@ -96,6 +96,33 @@ def main() -> None:
             full.extend(cb["uid"].values.tolist())
     resume_ok = (first + rest == full) and state.fingerprint is not None
 
+    # --- windowed row shuffle under multi-process sharding: each host
+    # shuffles ITS assignment; mid-window resume is exact and coverage
+    # matches the unshuffled stream ---
+    def shuffled_ds():
+        return TFRecordDataset(
+            data_dir, batch_size=4, schema=schema, drop_remainder=False,
+            process_index=pid, process_count=num_procs,
+            shuffle_window=2, seed=13,
+        )
+
+    with shuffled_ds().batches() as it:
+        s_first = next(it)["uid"].values.tolist()
+        s_state = it.state()
+    s_rest = []
+    with shuffled_ds().batches(s_state) as it:
+        for cb in it:
+            s_rest.extend(cb["uid"].values.tolist())
+    s_full = []
+    with shuffled_ds().batches() as it:
+        for cb in it:
+            s_full.extend(cb["uid"].values.tolist())
+    shuffle_ok = (
+        s_first + s_rest == s_full
+        and sorted(s_full) == sorted(full)
+        and s_full != full  # rows actually moved
+    )
+
     # --- coordinated multi-host write: per-host shards, one _SUCCESS ---
     from tpu_tfrecord.io.writer import DatasetWriter
     from tpu_tfrecord.options import TFRecordOptions
@@ -142,6 +169,7 @@ def main() -> None:
                 "marker_before": marker_before,
                 "marker_after": marker_after,
                 "resume_ok": resume_ok,
+                "shuffle_ok": shuffle_ok,
                 "host_rows_total": len(full),
             }
         )

@@ -106,6 +106,9 @@ def test_multi_process_schema_merge_and_global_batch(sandbox, tmp_path, num_proc
     # every host resumed mid-stream from a fingerprinted state without
     # dropping or duplicating rows, and hosts together saw all records
     assert all(o["resume_ok"] for o in outs)
+    # per-host windowed row shuffle: mid-window resume exact, coverage
+    # identical to the unshuffled stream, order actually permuted
+    assert all(o["shuffle_ok"] for o in outs)
     assert sum(o["host_rows_total"] for o in outs) == 8 * n_shards
     # coordinated write: marker appears only after the global barrier, and
     # the combined dataset contains every host's rows
