@@ -219,6 +219,21 @@ def main() -> None:
     if os.environ.get("TFR_BENCH_COLD", "0") != "0":
         cold_value = _cold_io_throughput(data_dir, schema, hash_buckets, pack)
 
+    def _fail_degraded(msg: str) -> None:
+        """One owner for the degraded artifact: the device-free evidence
+        plus the reason, whichever guard fired."""
+        err = {
+            "metric": "criteo_tf_example_ingest_to_device",
+            "error": msg,
+            # degraded-mode evidence: the device-free pipeline number
+            "host_side_value": round(host_side_value, 1),
+            "host_side_unit": "examples/sec/host (decode+hash+pack, no device)",
+        }
+        if cold_value is not None:
+            err["cold_value"] = round(cold_value, 1)
+        print(json.dumps(err), flush=True)
+        os._exit(3)
+
     # Backend-init watchdog: a dead TPU tunnel makes jax.devices() block
     # forever inside C (observed on this box) — fail loudly with a
     # diagnosable message instead of hanging the harness. Armed only around
@@ -228,22 +243,42 @@ def main() -> None:
 
     def _watchdog():
         if not backend_up.wait(float(os.environ.get("TFR_BENCH_INIT_TIMEOUT", 300))):
-            err = {
-                "metric": "criteo_tf_example_ingest_to_device",
-                "error": "TPU backend initialization timed out "
-                "(device tunnel unreachable?) — no device measurement taken",
-                # degraded-mode evidence: the device-free pipeline number
-                "host_side_value": round(host_side_value, 1),
-                "host_side_unit": "examples/sec/host (decode+hash+pack, no device)",
-            }
-            if cold_value is not None:
-                err["cold_value"] = round(cold_value, 1)
-            print(json.dumps(err), flush=True)
-            os._exit(3)
+            _fail_degraded(
+                "TPU backend initialization timed out "
+                "(device tunnel unreachable?) — no device measurement taken"
+            )
 
     threading.Thread(target=_watchdog, daemon=True).start()
     mesh = create_mesh()  # all available devices on the 'data' axis
     backend_up.set()
+
+    # Whole-run deadline: backend init succeeding doesn't mean the tunnel
+    # stays alive — a device_put after a mid-run tunnel death blocks forever
+    # inside C (observed), which would end the round with NO artifact at
+    # all. Default derives from the configured schedule (rests, retries,
+    # windows, sustain, train) so env overrides keep the guard honest.
+    run_done = threading.Event()
+    n_retries_cfg = max(0, int(os.environ.get("TFR_BENCH_RETRIES", 1)))
+    retry_rest_cfg = float(os.environ.get("TFR_BENCH_RETRY_REST", 150))
+    attempt_cost = MEASURE_SECONDS + SUSTAIN_SECONDS + 30  # probes + slack
+    default_deadline = (
+        REST_SECONDS
+        + (1 + n_retries_cfg) * attempt_cost
+        + n_retries_cfg * retry_rest_cfg
+        + 180  # train phase incl. compile/recompile
+    )
+    total_timeout = float(
+        os.environ.get("TFR_BENCH_TOTAL_TIMEOUT", default_deadline)
+    )
+
+    def _deadline():
+        if not run_done.wait(total_timeout):
+            _fail_degraded(
+                f"device phase exceeded {total_timeout:.0f}s "
+                "(tunnel died mid-run?) — no device measurement taken"
+            )
+
+    threading.Thread(target=_deadline, daemon=True).start()
     if REST_SECONDS > 0:
         # Open the link (one tiny warm transfer), then let it sit quiet:
         # the shaper's burst budget accrues against the OPEN connection —
@@ -434,6 +469,7 @@ def main() -> None:
     if train_duty is not None:
         # the BASELINE.md >=95% target metric (phase 2)
         out["duty_cycle"] = round(train_duty, 4)
+    run_done.set()
     print(json.dumps(out))
 
 

@@ -112,22 +112,19 @@ def main() -> None:
         # shuffle (rows permute across 8-batch windows; resume-exact)
         shuffle=True, shuffle_window=8, seed=0
     )
-    if resume is not None:
-        try:
-            it_probe = ds.batches(resume)
-        except ValueError as e:
-            # a state saved under a different dataset config (fingerprint
-            # mismatch, e.g. before shuffle settings changed) cannot resume
-            # — say why and start fresh rather than dying
-            print(f"saved input state incompatible ({e}); starting fresh")
-            resume = None
-        else:
-            it_probe.close()
     step = 0
     duty = DutyCycle()
     prev_loss = None
     t0 = time.perf_counter()
-    with ds.batches(resume) as it:
+    try:
+        it = ds.batches(resume)  # fingerprint validated eagerly
+    except ValueError as e:
+        # a state saved under a different dataset config (fingerprint
+        # mismatch, e.g. before shuffle settings changed) cannot resume —
+        # say why and start fresh rather than dying
+        print(f"saved input state incompatible ({e}); starting fresh")
+        it = ds.batches(None)
+    with it:
         while True:
             # wait window covers EVERYTHING the host does between steps,
             # including blocking on the prefetch queue — otherwise the duty
