@@ -216,7 +216,10 @@ def main() -> None:
         seconds=float(os.environ.get("TFR_BENCH_HOST_SECONDS", 4.0)),
     )
     cold_value = None
-    if os.environ.get("TFR_BENCH_COLD", "0") != "0":
+    if os.environ.get("TFR_BENCH_COLD", "1") != "0":
+        # ON by default so every round's artifact includes a number with
+        # real disk IO in it (one dropped-page-cache pass, ~1s); set
+        # TFR_BENCH_COLD=0 to skip.
         cold_value = _cold_io_throughput(data_dir, schema, hash_buckets, pack)
 
     def _fail_degraded(msg: str) -> None:
