@@ -155,6 +155,74 @@ class TestNativeExampleDecode:
         assert_batches_equal(got, want)
 
 
+class TestTurboShapeVariants:
+    """The turbo parser keeps per-slot alternate entry-shape caches keyed by
+    total entry length (varint ints drift among a handful of byte lengths).
+    These cases force constant MRU misses so the alternate-probe lane and
+    its promotion/eviction paths all execute, pinned to the Python oracle."""
+
+    def _roundtrip(self, schema, rows_feats, **kw):
+        recs = [encode_example(Example(features=f)) for f in rows_feats]
+        got = _native.NativeDecoder(schema, **kw).decode_batch(recs)
+        want = ColumnarDecoder(schema).decode_batch(recs)
+        assert_batches_equal(got, want)
+        return got
+
+    def test_alternating_varint_lengths_match_oracle(self):
+        # Cycle each int through 1..10-byte varints (incl. negatives, which
+        # encode as 10 bytes) so every record misses the MRU for some field.
+        schema = StructType(
+            [StructField("a", LongType()), StructField("b", LongType())]
+        )
+        vals = [1, 2**7, 2**14, 2**21, 2**28, 2**35, 2**42, 2**49, 2**56, -1]
+        rows = [
+            {
+                "a": Feature.int64_list([vals[k % len(vals)]]),
+                "b": Feature.int64_list([vals[(k * 3 + 1) % len(vals)]]),
+            }
+            for k in range(64)
+        ]
+        self._roundtrip(schema, rows)
+
+    def test_more_lengths_than_alternate_slots(self):
+        # >6 distinct shapes per slot: round-robin eviction must stay correct
+        # (worst case it just re-parses field-wise; values must not change).
+        schema = StructType([StructField("x", LongType())])
+        rng = np.random.default_rng(7)
+        rows = [
+            {"x": Feature.int64_list([int(rng.integers(0, 2**63 - 1)) >> (7 * (k % 9))])}
+            for k in range(200)
+        ]
+        self._roundtrip(schema, rows)
+
+    def test_variable_length_bytes_and_pruned_columns(self):
+        # bytes values of drifting lengths exercise the alternate lane for
+        # BYTES kinds; the unrequested wide column exercises the pruned-slot
+        # (idx<0) alternates.
+        schema = StructType([StructField("s", StringType()), StructField("n", LongType())])
+        rows = []
+        for k in range(64):
+            rows.append(
+                {
+                    "s": Feature.bytes_list([b"x" * (1 + (k * 5) % 23)]),
+                    "n": Feature.int64_list([k * (2**27)]),
+                    "skip_me": Feature.bytes_list([b"y" * ((k * 11) % 37)]),
+                }
+            )
+        self._roundtrip(schema, rows)
+
+    def test_hashed_bytes_with_drifting_lengths(self):
+        from tpu_tfrecord.tpu.ingest import hash_bytes_column
+
+        schema = StructType([StructField("c", StringType())])
+        blobs = [b"k" * (1 + (k * 3) % 17) for k in range(48)]
+        rows = [{"c": Feature.bytes_list([b])} for b in blobs]
+        recs = [encode_example(Example(features=f)) for f in rows]
+        got = _native.NativeDecoder(schema, hash_buckets={"c": 1 << 10}).decode_batch(recs)
+        want = hash_bytes_column(blobs, 1 << 10)
+        np.testing.assert_array_equal(got["c"].values, np.asarray(want, dtype=np.int32))
+
+
 class TestNativeSequenceExampleDecode:
     SCHEMA = StructType(
         [

@@ -17,6 +17,7 @@
 //
 // Build: g++ -std=c++20 -O3 -fPIC -shared [-msse4.2] tfrecord_native.cc
 
+#include <array>
 #include <cstdint>
 #include <cstring>
 #include <cstdio>
@@ -810,17 +811,54 @@ bool parse_feature_lists(const uint8_t* p, const uint8_t* end, const FieldMap& f
 // Applies to Example records whose schema is all-scalar (the common dense
 // tabular case, e.g. Criteo).
 
-struct TurboSlot {
-  std::vector<uint8_t> prefix;  // 0x0A klen <key bytes>
-  int idx;                      // field index, or -1 (pruned: skip entry)
-  // Adaptive full-entry cache: records from one serializer usually repeat
-  // the exact entry byte shape (all tags + lengths), differing only in the
-  // value payload. When the cached shape matches (ONE memcmp), the value
-  // sits at a fixed offset — no per-field tag walking at all. A miss falls
-  // back to the field-wise parse below, which refreshes the cache.
+// One cached entry byte shape: all tags + lengths up to the value payload.
+// When a record's entry matches the cached bytes (ONE memcmp), the value
+// sits at a fixed offset — no per-field tag walking at all.
+struct SlotShape {
   std::vector<uint8_t> cache;   // entry bytes from entry tag to value start
   uint32_t entry_total = 0;     // full entry byte length (tag..end)
   uint32_t value_len = 0;       // value payload bytes (BYTES/FLOAT: fixed)
+};
+
+struct TurboSlot {
+  std::vector<uint8_t> prefix;  // 0x0A klen <key bytes>
+  int idx;                      // field index, or -1 (pruned: skip entry)
+  // Adaptive entry-shape caches: records from one serializer usually repeat
+  // the exact entry byte shape, differing only in the value payload. Varint
+  // int values drift among a handful of BYTE LENGTHS (uniform 31-bit ints
+  // are ~87% 5-byte / ~12% 4-byte varints), and each length implies a
+  // distinct but recurring skeleton — so beyond the MRU shape a small set
+  // of alternates is kept, keyed by total entry length. The MRU check is
+  // one memcmp; an MRU miss probes the alternates by the candidate entry
+  // length read from the entry's own length byte before falling back to
+  // the field-wise parse (which verifies and remembers the new shape).
+  SlotShape mru;
+  std::array<SlotShape, 6> alts;
+  int n_alts = 0;
+  uint32_t alt_rr = 0;          // round-robin eviction cursor
+
+  // Record a field-wise-verified shape as the MRU, demoting the outgoing
+  // MRU into the alternate set (replacing any alternate with the same
+  // total length). The new shape lives ONLY in the MRU — storing it in the
+  // alternates too would let the promotion swap breed duplicates that
+  // evict distinct live shapes.
+  void remember(const uint8_t* start, const uint8_t* vstart, uint32_t etot,
+                uint32_t vlen) {
+    if (mru.entry_total && mru.entry_total != etot) {
+      int slot = -1;
+      for (int i = 0; i < n_alts; i++) {
+        if (alts[i].entry_total == mru.entry_total) { slot = i; break; }
+      }
+      if (slot < 0) {
+        slot = n_alts < (int)alts.size() ? n_alts++
+                                         : (int)(alt_rr++ % alts.size());
+      }
+      alts[slot] = std::move(mru);
+    }
+    mru.cache.assign(start, vstart);
+    mru.entry_total = etot;
+    mru.value_len = vlen;
+  }
 };
 
 
@@ -858,18 +896,36 @@ bool turbo_parse(const uint8_t* rp, const uint8_t* rend,
   for (; si < n_slots; si++) {
     TurboSlot& s = slots[si];
     // --- cache-hit fast lane: one memcmp covers every tag and length ---
-    if (s.entry_total && (uint64_t)(rend - p) >= s.entry_total &&
-        std::memcmp(p, s.cache.data(), s.cache.size()) == 0) {
-      const uint8_t* q = p + s.cache.size();
-      p += s.entry_total;
+    const SlotShape* shape = nullptr;
+    if (s.mru.entry_total && (uint64_t)(rend - p) >= s.mru.entry_total &&
+        std::memcmp(p, s.mru.cache.data(), s.mru.cache.size()) == 0) {
+      shape = &s.mru;
+    } else if (s.n_alts && (uint64_t)(rend - p) >= 2 && p[0] == 0x0A &&
+               p[1] < 0x80) {
+      // MRU miss: the entry's own (single-byte) length varint names the
+      // candidate total length; probe the alternates for that shape.
+      uint32_t etot = 2u + p[1];
+      for (int a = 0; a < s.n_alts; a++) {
+        SlotShape& v = s.alts[a];
+        if (v.entry_total == etot && (uint64_t)(rend - p) >= etot &&
+            std::memcmp(p, v.cache.data(), v.cache.size()) == 0) {
+          std::swap(s.mru, v);  // promote; old MRU stays as an alternate
+          shape = &s.mru;
+          break;
+        }
+      }
+    }
+    if (shape) {
+      const uint8_t* q = p + shape->cache.size();
+      p += shape->entry_total;
       if (s.idx < 0) continue;
       ColBuilder& col = cols[s.idx];
       col.cur_row = epoch;
       if (col.kind == KIND_INT64) {
-        // value: one-varint-or-more packed run of s.value_len bytes. The
+        // value: one-varint-or-more packed run of value_len bytes. The
         // fast varint may load past ve (within the record) — the q > ve
         // check catches a run with no terminator, like the bounded read.
-        const uint8_t* ve = q + s.value_len;
+        const uint8_t* ve = q + shape->value_len;
         uint64_t v;
         if (!turbo_varint_fast(q, rend, &v) || q > ve) return abort_record();
         while (q < ve) {  // rest of the run: validate well-formed varints
@@ -881,10 +937,10 @@ bool turbo_parse(const uint8_t* rp, const uint8_t* rend,
         col.push_i64((int64_t)v);
       } else if (col.kind == KIND_BYTES) {
         if (col.hash_buckets > 0) {
-          uint32_t h = crc32c_hash(q, s.value_len);
+          uint32_t h = crc32c_hash(q, shape->value_len);
           col.push_hashed((int32_t)(h % (uint64_t)col.hash_buckets));
         } else {
-          col.push_bytes(q, s.value_len);
+          col.push_bytes(q, shape->value_len);
         }
       } else {  // KIND_FLOAT
         float v;
@@ -909,9 +965,7 @@ bool turbo_parse(const uint8_t* rp, const uint8_t* rend,
     if (s.idx < 0) {
       // pruned column: cache the key prefix so future skips are one memcmp
       if (ee - p0 < 0x10000) {
-        s.cache.assign(p0, p0 + (q - p0));
-        s.entry_total = (uint32_t)(ee - p0);
-        s.value_len = 0;
+        s.remember(p0, q, (uint32_t)(ee - p0), 0);
       }
       continue;
     }
@@ -1000,13 +1054,11 @@ bool turbo_parse(const uint8_t* rp, const uint8_t* rend,
       }
       col.push_f32(v);
     }
-    // refresh the adaptive cache: entry header bytes up to the value
+    // refresh the adaptive caches: entry header bytes up to the value
     // payload; value fills the rest of the entry exactly (verified above)
     if (vstart && (uint64_t)(vstart - p0) + vlen == (uint64_t)(ee - p0) &&
         ee - p0 < 0x10000) {
-      s.cache.assign(p0, vstart);
-      s.entry_total = (uint32_t)(ee - p0);
-      s.value_len = vlen;
+      s.remember(p0, vstart, (uint32_t)(ee - p0), vlen);
     }
     n_written++;  // mask slot is pre-filled 1
   }
