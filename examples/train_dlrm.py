@@ -91,8 +91,20 @@ def main() -> None:
     )
     params = init_params(jax.random.key(0), cfg)
     tx = optax.adam(1e-3)
-    opt_state = tx.init(params)
-    step_fn = jax.jit(functools.partial(train_step, cfg=cfg, tx=tx), donate_argnums=(0, 1))
+    if os.environ.get("DLRM_SPARSE", "0") == "1":
+        # Sparse embedding updates (row-wise AdaGrad on touched rows only):
+        # the table gradient never materializes, which is what makes real
+        # Criteo vocabularies (2^20+ rows/table) trainable — see
+        # models.dlrm.sparse_train_step. Adam still drives the MLPs.
+        from tpu_tfrecord.models import sparse_opt_init, sparse_train_step
+
+        opt_state = sparse_opt_init(params, cfg, tx)
+        step_fn = jax.jit(
+            functools.partial(sparse_train_step, cfg=cfg, tx=tx), donate_argnums=(0, 1)
+        )
+    else:
+        opt_state = tx.init(params)
+        step_fn = jax.jit(functools.partial(train_step, cfg=cfg, tx=tx), donate_argnums=(0, 1))
 
     hash_buckets = {f"C{i}": VOCAB for i in range(NUM_CAT)}
     pack = {

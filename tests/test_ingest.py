@@ -170,6 +170,67 @@ class TestShardedIngest:
         assert count == 4
         assert sorted(seen_uids) == list(range(32))
 
+    def test_device_iterator_transfer_thread_equivalent(self, sandbox):
+        """transfer_thread=True must yield the same device batches in the
+        same order as the inline path (the worker only moves WHERE the copy
+        blocks, never what arrives)."""
+        out = write_dataset(sandbox, n=32)
+        mesh = create_mesh()
+        ds = TFRecordDataset(out, batch_size=8, schema=SCHEMA)
+
+        def host_batches():
+            with ds.batches() as it:
+                for cb in it:
+                    yield host_batch_from_columnar(cb, ds.schema, pad_to={"emb": 3})
+
+        with DeviceIterator(host_batches(), mesh, transfer_thread=True) as dev_it:
+            seen = []
+            for gb in dev_it:
+                assert gb["uid"].sharding.spec == P("data")
+                seen.extend(np.asarray(gb["uid"]).tolist())
+        assert sorted(seen) == list(range(32))
+        # exhausted: a further next() raises StopIteration, not a hang
+        import pytest as _pytest
+
+        with _pytest.raises(StopIteration):
+            next(dev_it)
+
+    def test_device_iterator_transfer_thread_propagates_errors(self):
+        mesh = create_mesh()
+        n = mesh.devices.size
+
+        def bad_batches():
+            yield {"x": np.arange(n, dtype=np.int32)}
+            raise RuntimeError("producer exploded")
+
+        with DeviceIterator(bad_batches(), mesh, transfer_thread=True) as dev_it:
+            next(dev_it)
+            import pytest as _pytest
+
+            with _pytest.raises(RuntimeError, match="producer exploded"):
+                next(dev_it)
+
+    def test_device_iterator_transfer_thread_close_mid_stream(self):
+        """close() while the producer is still running must unblock and
+        join the worker; later next() raises StopIteration."""
+        mesh = create_mesh()
+        n = mesh.devices.size
+
+        def endless():
+            i = 0
+            while True:
+                yield {"x": np.full((n,), i, dtype=np.int32)}
+                i += 1
+
+        dev_it = DeviceIterator(endless(), mesh, transfer_thread=True)
+        next(dev_it)
+        dev_it.close()
+        import pytest as _pytest
+
+        with _pytest.raises(StopIteration):
+            next(dev_it)
+        assert not dev_it._pf._thread.is_alive()
+
     def test_device_iterator_rebuilds_shardings_on_ndim_change(self):
         """Regression (ADVICE r2): the sharding cache was keyed only on dict
         keys — an array whose RANK changes between batches must rebuild its

@@ -78,6 +78,146 @@ class TestGraftEntry:
         ge.dryrun_multichip(n)
 
 
+class TestSparseTrainStep:
+    """sparse_train_step: embedding grads via gathered rows + scatter-add
+    (no dense [F, V, D] gradient), row-wise AdaGrad on touched rows."""
+
+    CFG = DLRMConfig(num_dense=4, num_categorical=3, vocab_size=64, embed_dim=4,
+                     bottom_mlp=(8, 4), top_mlp=(8, 1), dtype=jax.numpy.float32)
+
+    @staticmethod
+    def _dense_rowwise_adagrad_reference(params, opt_state, batch, cfg, tx,
+                                         embed_lr=0.01, embed_eps=1e-8):
+        """Oracle: full dense table gradient + row-wise AdaGrad applied
+        densely (rows with zero gradient keep their accumulator — true when
+        the batch has NO duplicate indices)."""
+        from tpu_tfrecord.models.dlrm import SparseEmbOptState
+
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
+        g_table = grads.pop("embeddings").astype(jax.numpy.float32)
+        updates, dense_state = tx.update(
+            grads, opt_state.dense, {k: v for k, v in params.items() if k != "embeddings"}
+        )
+        dense_params = jax.tree.map(
+            lambda p, u: p + u,
+            {k: v for k, v in params.items() if k != "embeddings"},
+            updates,
+        )
+        touched = (g_table != 0).any(axis=-1)                       # [F, V]
+        row_ms = (g_table * g_table).mean(axis=-1)                  # [F, V]
+        accum = opt_state.accum + jax.numpy.where(touched, row_ms, 0.0)
+        scale = embed_lr * jax.lax.rsqrt(accum + embed_eps)         # [F, V]
+        table = params["embeddings"] - jax.numpy.where(
+            touched[..., None], scale[..., None] * g_table, 0.0
+        )
+        return dict(dense_params, embeddings=table), SparseEmbOptState(dense_state, accum), loss
+
+    def test_matches_dense_reference_without_duplicates(self):
+        from tpu_tfrecord.models import sparse_opt_init, sparse_train_step
+
+        cfg = self.CFG
+        params = init_params(jax.random.key(5), cfg)
+        host = make_synthetic_batch(cfg, 8, seed=11)
+        # force DISTINCT indices per feature column (duplicate handling is
+        # pinned separately below)
+        rng = np.random.default_rng(3)
+        for f in range(cfg.num_categorical):
+            host["cat"][:, f] = rng.choice(cfg.vocab_size, size=8, replace=False)
+        batch = {k: jax.numpy.asarray(v) for k, v in host.items()}
+        tx = optax.sgd(1e-2)
+        opt0 = sparse_opt_init(params, cfg, tx)
+
+        got_p, got_s, got_l = jax.jit(
+            functools.partial(sparse_train_step, cfg=cfg, tx=tx)
+        )(params, opt0, batch)
+        want_p, want_s, want_l = self._dense_rowwise_adagrad_reference(
+            params, opt0, batch, cfg, tx
+        )
+        assert float(got_l) == pytest.approx(float(want_l), rel=1e-6)
+        np.testing.assert_allclose(got_s.accum, want_s.accum, rtol=1e-5, atol=1e-8)
+        np.testing.assert_allclose(
+            got_p["embeddings"], want_p["embeddings"], rtol=1e-5, atol=1e-7
+        )
+        for (ga, wa) in zip(jax.tree.leaves(got_p["top"]), jax.tree.leaves(want_p["top"])):
+            np.testing.assert_allclose(ga, wa, rtol=1e-5, atol=1e-7)
+
+    def test_duplicate_indices_accumulate_exactly(self):
+        from tpu_tfrecord.models import sparse_opt_init, sparse_train_step
+
+        cfg = self.CFG
+        params = init_params(jax.random.key(6), cfg)
+        host = make_synthetic_batch(cfg, 6, seed=13)
+        host["cat"][:] = 7  # every example hits the SAME row of every table
+        batch = {k: jax.numpy.asarray(v) for k, v in host.items()}
+        tx = optax.sgd(1e-2)
+        opt0 = sparse_opt_init(params, cfg, tx)
+        embed_lr, embed_eps = 0.01, 1e-8
+
+        got_p, got_s, _ = jax.jit(
+            functools.partial(sparse_train_step, cfg=cfg, tx=tx,
+                              embed_lr=embed_lr, embed_eps=embed_eps)
+        )(params, opt0, batch)
+
+        # oracle: dense table grad row == sum of per-example row grads;
+        # accumulator adds the SUM of per-example mean-squares; the scale
+        # from the post-accumulation value applies to the summed gradient.
+        _, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
+        g_table = np.asarray(grads["embeddings"], dtype=np.float32)
+
+        def rows_grad(r):
+            # per-example row grads [B, F, D] (differentiate w.r.t. rows)
+            table = params["embeddings"]
+            f_ix = jax.numpy.arange(cfg.num_categorical)[None, :]
+            rows = table[f_ix, batch["cat"]]
+            dp = {k: v for k, v in params.items() if k != "embeddings"}
+            return jax.grad(lambda rr: loss_fn(dp, batch, cfg, emb=rr))(rows)
+
+        g_rows = np.asarray(rows_grad(None), dtype=np.float32)      # [B, F, D]
+        ms_sum = (g_rows ** 2).mean(axis=-1).sum(axis=0)            # [F]
+        for f in range(cfg.num_categorical):
+            want_acc = ms_sum[f]
+            assert float(got_s.accum[f, 7]) == pytest.approx(want_acc, rel=1e-5)
+            scale = embed_lr / np.sqrt(want_acc + embed_eps)
+            want_row = np.asarray(params["embeddings"])[f, 7] - scale * g_table[f, 7]
+            np.testing.assert_allclose(got_p["embeddings"][f, 7], want_row,
+                                       rtol=1e-4, atol=1e-7)
+            # untouched rows unchanged
+            np.testing.assert_array_equal(
+                got_p["embeddings"][f, 8], np.asarray(params["embeddings"])[f, 8]
+            )
+
+    def test_sharded_sparse_step_matches_single_device(self):
+        from tpu_tfrecord.models import sparse_opt_init, sparse_train_step
+        from tpu_tfrecord.models.dlrm import batch_shardings
+
+        cfg = self.CFG
+        params = init_params(jax.random.key(8), cfg)
+        host = make_synthetic_batch(cfg, 16, seed=17)
+        batch1 = {k: jax.numpy.asarray(v) for k, v in host.items()}
+        tx = optax.sgd(1e-2)
+        opt0 = sparse_opt_init(params, cfg, tx)
+        want_p, _, want_l = jax.jit(
+            functools.partial(sparse_train_step, cfg=cfg, tx=tx)
+        )(params, opt0, batch1)
+
+        mesh = create_mesh({"data": 4, "model": 2})
+        p_shard = param_shardings(mesh, params)
+        sharded_params = jax.device_put(params, p_shard)
+        b_shard = batch_shardings(mesh, host)
+        batch = {
+            k: jax.make_array_from_process_local_data(b_shard[k], v)
+            for k, v in host.items()
+        }
+        got_p, _, got_l = jax.jit(
+            functools.partial(sparse_train_step, cfg=cfg, tx=tx)
+        )(sharded_params, opt0, batch)
+        assert float(got_l) == pytest.approx(float(want_l), rel=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(got_p["embeddings"]), np.asarray(want_p["embeddings"]),
+            rtol=1e-5, atol=1e-7,
+        )
+
+
 class TestShardedTrainStep:
     def test_tp_matches_replicated(self):
         """The tensor-parallel layout must compute the same loss as fully

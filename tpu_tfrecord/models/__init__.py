@@ -22,11 +22,14 @@ other.
 from tpu_tfrecord.models import dlrm, long_doc
 from tpu_tfrecord.models.dlrm import (
     DLRMConfig,
+    SparseEmbOptState,
     forward,
     init_params,
     loss_fn,
     make_synthetic_batch,
     param_shardings,
+    sparse_opt_init,
+    sparse_train_step,
     train_step,
 )
 
@@ -38,6 +41,9 @@ __all__ = [
     "forward",
     "loss_fn",
     "train_step",
+    "SparseEmbOptState",
+    "sparse_opt_init",
+    "sparse_train_step",
     "param_shardings",
     "make_synthetic_batch",
 ]

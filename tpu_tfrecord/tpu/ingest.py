@@ -378,13 +378,24 @@ class DeviceIterator:
     as soon as the transfer is enqueued). This is the device_put overlap the
     reference never needed (the JVM never touched an accelerator) but a TPU
     input pipeline lives or dies by (SURVEY.md §7 hard part e).
-    """
+
+    ``transfer_thread=True`` moves the transfer into a dedicated worker that
+    BLOCKS each copy to completion behind a bounded queue of device-resident
+    batches. On platforms where the host-to-device copy is synchronous at
+    dispatch (a dispatched transfer makes no progress until some thread
+    blocks on it — true of network-tunneled devices, unlike PCIe PJRT's
+    async H2D engine), dispatch-ahead alone overlaps nothing; the worker
+    thread restores the overlap because it does its blocking while the
+    consumer thread sits inside the device step. Use ``close()`` (or a
+    ``with`` block) to release the worker."""
 
     def __init__(
         self,
         host_batches: Iterable[Dict[str, np.ndarray]],
         mesh: Mesh,
         axis: str = "data",
+        transfer_thread: bool = False,
+        depth: int = 2,
     ):
         self._it = iter(host_batches)
         self._mesh = mesh
@@ -392,6 +403,19 @@ class DeviceIterator:
         self._pending: Optional[Dict[str, jax.Array]] = None
         self._shardings: Optional[Dict[str, NamedSharding]] = None
         self._sharding_key: Optional[Dict[str, int]] = None
+        self._pf: Optional[HostPrefetcher] = None
+        if transfer_thread:
+            # Delegate the thread/queue/sentinel protocol to HostPrefetcher
+            # (it is item-type-agnostic); the generator below is what runs
+            # on its worker: transfer + block each copy to completion, so
+            # the consumer pops already-device-resident batches.
+            def _transferred():
+                for host in self._it:
+                    gb = self._transfer(host)
+                    jax.block_until_ready(gb)
+                    yield gb
+
+            self._pf = HostPrefetcher(_transferred(), depth=depth)
 
     def _transfer(self, host: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
         # Cache key includes each array's ndim: a same-named array changing
@@ -407,6 +431,8 @@ class DeviceIterator:
         return self
 
     def __next__(self) -> Dict[str, jax.Array]:
+        if self._pf is not None:
+            return next(self._pf)
         if self._pending is None:
             host = next(self._it)  # raises StopIteration at end
             self._pending = self._transfer(host)
@@ -418,3 +444,14 @@ class DeviceIterator:
             return current
         self._pending = self._transfer(nxt)
         return current
+
+    def close(self) -> None:
+        """Release the transfer worker (no-op without ``transfer_thread``)."""
+        if self._pf is not None:
+            self._pf.close()
+
+    def __enter__(self) -> "DeviceIterator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
