@@ -231,9 +231,14 @@ def main() -> None:
         """One owner for the guard-fired artifact. If the measurement
         attempts already completed, emit the REAL headline (best attempt)
         with the failure noted — only the phases after the measurement were
-        lost. Otherwise emit the device-free evidence plus the reason."""
-        if completed_attempts:
-            best = max(completed_attempts, key=lambda a: a["value"])
+        lost. Otherwise emit the device-free evidence plus the reason.
+
+        Runs on watchdog/deadline daemon threads while the main thread may
+        still be appending: snapshot the list once and read only the
+        snapshot (r3 advisor — unsynchronized shared state before os._exit)."""
+        attempts_snap = list(completed_attempts)
+        if attempts_snap:
+            best = max(attempts_snap, key=lambda a: a["value"])
             out = {
                 "metric": "criteo_tf_example_ingest_to_device",
                 "value": best["value"],
@@ -244,7 +249,7 @@ def main() -> None:
                 "link_probe_mbps": best["link_probe_mbps"],
                 "ingest_duty_cycle": best["ingest_duty_cycle"],
                 "host_side_value": round(host_side_value, 1),
-                "attempts": completed_attempts,
+                "attempts": attempts_snap,
                 "error": msg,
             }
             if cold_value is not None:
@@ -596,9 +601,12 @@ def _train_duty_cycle(ds, mesh, hash_buckets, pack, top_mlp, seconds=6.0):
             )
             yield {"wire": pack_mixed(hb["packed"], 14, CAT_BITS)}
 
-    prefetcher = HostPrefetcher(host_batches())
-    dev_it = DeviceIterator(prefetcher, mesh, transfer_thread=True)
+    # Both constructors spawn worker threads: build them INSIDE the try so a
+    # ctor failure still reaches the finally and nothing leaks (r3 advisor).
+    prefetcher = dev_it = None
     try:
+        prefetcher = HostPrefetcher(host_batches())
+        dev_it = DeviceIterator(prefetcher, mesh, transfer_thread=True)
         duty = DutyCycle()
         # warm THREE full iterations: the first call compiles, and the
         # second can recompile (donated outputs come back device-resident
@@ -626,8 +634,10 @@ def _train_duty_cycle(ds, mesh, hash_buckets, pack, top_mlp, seconds=6.0):
                 float(loss)  # force true completion (see note above)
         return duty.value()
     finally:
-        dev_it.close()
-        prefetcher.close()
+        if dev_it is not None:
+            dev_it.close()
+        if prefetcher is not None:
+            prefetcher.close()
         it.close()
 
 

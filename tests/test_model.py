@@ -89,8 +89,9 @@ class TestSparseTrainStep:
     def _dense_rowwise_adagrad_reference(params, opt_state, batch, cfg, tx,
                                          embed_lr=0.01, embed_eps=1e-8):
         """Oracle: full dense table gradient + row-wise AdaGrad applied
-        densely (rows with zero gradient keep their accumulator — true when
-        the batch has NO duplicate indices)."""
+        densely. With dedup-first duplicate semantics (r4) this is exact for
+        ANY index pattern — the dense gradient row IS the deduped sum
+        (barring exact float cancellation making a touched row read zero)."""
         from tpu_tfrecord.models.dlrm import SparseEmbOptState
 
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
@@ -158,24 +159,16 @@ class TestSparseTrainStep:
                               embed_lr=embed_lr, embed_eps=embed_eps)
         )(params, opt0, batch)
 
-        # oracle: dense table grad row == sum of per-example row grads;
-        # accumulator adds the SUM of per-example mean-squares; the scale
-        # from the post-accumulation value applies to the summed gradient.
+        # DEDUP-FIRST oracle (r4, matches dense row-wise AdaGrad / TF
+        # IndexedSlices consumers): duplicates sum their row gradients
+        # FIRST; the accumulator adds mean((sum g)^2) ONCE per unique row;
+        # the scale from the post-accumulation value applies to the summed
+        # gradient. The dense table gradient row IS the summed gradient.
         _, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
         g_table = np.asarray(grads["embeddings"], dtype=np.float32)
 
-        def rows_grad(r):
-            # per-example row grads [B, F, D] (differentiate w.r.t. rows)
-            table = params["embeddings"]
-            f_ix = jax.numpy.arange(cfg.num_categorical)[None, :]
-            rows = table[f_ix, batch["cat"]]
-            dp = {k: v for k, v in params.items() if k != "embeddings"}
-            return jax.grad(lambda rr: loss_fn(dp, batch, cfg, emb=rr))(rows)
-
-        g_rows = np.asarray(rows_grad(None), dtype=np.float32)      # [B, F, D]
-        ms_sum = (g_rows ** 2).mean(axis=-1).sum(axis=0)            # [F]
         for f in range(cfg.num_categorical):
-            want_acc = ms_sum[f]
+            want_acc = float((g_table[f, 7] ** 2).mean())
             assert float(got_s.accum[f, 7]) == pytest.approx(want_acc, rel=1e-5)
             scale = embed_lr / np.sqrt(want_acc + embed_eps)
             want_row = np.asarray(params["embeddings"])[f, 7] - scale * g_table[f, 7]
@@ -185,6 +178,36 @@ class TestSparseTrainStep:
             np.testing.assert_array_equal(
                 got_p["embeddings"][f, 8], np.asarray(params["embeddings"])[f, 8]
             )
+
+    def test_mixed_duplicate_group_sizes_match_dense_oracle(self):
+        # Group sizes m VARY within one batch (indices drawn from a tiny
+        # range): a bug wrong only when different-sized duplicate groups
+        # coexist (e.g. a scale paired with the wrong group's m) passes
+        # both the no-duplicates and the all-duplicates cases — this pins
+        # the realistic skewed-index regime. Dedup-first semantics make the
+        # dense row-wise AdaGrad oracle exact for ANY index pattern.
+        from tpu_tfrecord.models import sparse_opt_init, sparse_train_step
+
+        cfg = self.CFG
+        params = init_params(jax.random.key(9), cfg)
+        host = make_synthetic_batch(cfg, 64, seed=21)
+        host["cat"] = np.random.default_rng(23).integers(
+            0, 6, size=host["cat"].shape
+        )  # ~10x duplication, uneven group sizes
+        batch = {k: jax.numpy.asarray(v) for k, v in host.items()}
+        tx = optax.sgd(1e-2)
+        opt0 = sparse_opt_init(params, cfg, tx)
+        got_p, got_s, got_l = jax.jit(
+            functools.partial(sparse_train_step, cfg=cfg, tx=tx)
+        )(params, opt0, batch)
+        want_p, want_s, want_l = self._dense_rowwise_adagrad_reference(
+            params, opt0, batch, cfg, tx
+        )
+        assert float(got_l) == pytest.approx(float(want_l), rel=1e-6)
+        np.testing.assert_allclose(got_s.accum, want_s.accum, rtol=2e-5, atol=1e-9)
+        np.testing.assert_allclose(
+            got_p["embeddings"], want_p["embeddings"], rtol=2e-5, atol=1e-7
+        )
 
     def test_sharded_sparse_step_matches_single_device(self):
         from tpu_tfrecord.models import sparse_opt_init, sparse_train_step

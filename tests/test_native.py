@@ -211,6 +211,42 @@ class TestTurboShapeVariants:
             )
         self._roundtrip(schema, rows)
 
+    def test_large_entries_alternate_between_two_shapes(self):
+        # Entries whose total length needs a 2-BYTE length varint (>= ~130
+        # bytes, e.g. long bytes values): the alternate probe must decode
+        # the 2-byte varint to preselect, and remember() must keep such
+        # shapes in the alternate set (r4; previously they occupied slots
+        # the 1-byte-only probe could never match). Alternating two large
+        # shapes makes EVERY record an MRU miss that only the large-entry
+        # probe lane can serve; correctness is pinned to the oracle either
+        # way (a probe miss just re-parses field-wise).
+        schema = StructType([StructField("doc", StringType()), StructField("n", LongType())])
+        rows = []
+        for k in range(64):
+            size = 200 if k % 2 == 0 else 900
+            rows.append(
+                {
+                    "doc": Feature.bytes_list([bytes([65 + k % 26]) * size]),
+                    "n": Feature.int64_list([k]),
+                }
+            )
+        self._roundtrip(schema, rows)
+
+    def test_oversized_entries_never_occupy_alternate_slots(self):
+        # Shapes beyond the probe's 2-byte reach (> 16386 total) must not
+        # round-robin-evict live alternates; decode stays oracle-equal.
+        schema = StructType([StructField("blob", BinaryType()), StructField("n", LongType())])
+        rows = []
+        for k in range(32):
+            size = 20_000 if k % 3 == 0 else (140 + (k % 5) * 70)
+            rows.append(
+                {
+                    "blob": Feature.bytes_list([bytes([k % 251]) * size]),
+                    "n": Feature.int64_list([k * 2**40]),
+                }
+            )
+        self._roundtrip(schema, rows)
+
     def test_hashed_bytes_with_drifting_lengths(self):
         from tpu_tfrecord.tpu.ingest import hash_bytes_column
 

@@ -69,11 +69,18 @@ def _looped(fn, k: int):
 
 
 def _time_loop(run, emb) -> float:
-    run(emb).block_until_ready()  # compile + warm
+    # Completion is forced with a SCALAR FETCH of the loop's f32 accumulator,
+    # not block_until_ready: on this tunneled client block_until_ready
+    # returns before the computation actually finishes (bench.py observed a
+    # chain of twenty 4096^2 matmuls "complete" in ~0ms; the 4-byte d2h
+    # fetch waits for true execution). The fetch's round-trip latency is a
+    # constant per timing, so the two-length delta cancels it exactly like
+    # dispatch.
+    float(run(emb))  # compile + warm
     best = float("inf")
     for _ in range(REPEATS):
         t0 = time.perf_counter()
-        run(emb).block_until_ready()
+        float(run(emb))
         best = min(best, time.perf_counter() - t0)
     return best
 

@@ -837,6 +837,12 @@ struct TurboSlot {
   int n_alts = 0;
   uint32_t alt_rr = 0;          // round-robin eviction cursor
 
+  // The alternate probe decodes the entry's 1- or 2-byte length varint, so
+  // only totals <= 3 + 16383 can ever match an alternate; larger shapes
+  // must not occupy (or round-robin-evict) slots they can never win from
+  // (r3 advisor finding).
+  static bool probe_reachable(uint32_t etot) { return etot <= 3u + 16383u; }
+
   // Record a field-wise-verified shape as the MRU, demoting the outgoing
   // MRU into the alternate set (replacing any alternate with the same
   // total length). The new shape lives ONLY in the MRU — storing it in the
@@ -844,7 +850,8 @@ struct TurboSlot {
   // evict distinct live shapes.
   void remember(const uint8_t* start, const uint8_t* vstart, uint32_t etot,
                 uint32_t vlen) {
-    if (mru.entry_total && mru.entry_total != etot) {
+    if (mru.entry_total && mru.entry_total != etot &&
+        probe_reachable(mru.entry_total)) {
       int slot = -1;
       for (int i = 0; i < n_alts; i++) {
         if (alts[i].entry_total == mru.entry_total) { slot = i; break; }
@@ -900,16 +907,30 @@ bool turbo_parse(const uint8_t* rp, const uint8_t* rend,
     if (s.mru.entry_total && (uint64_t)(rend - p) >= s.mru.entry_total &&
         std::memcmp(p, s.mru.cache.data(), s.mru.cache.size()) == 0) {
       shape = &s.mru;
-    } else if (s.n_alts && (uint64_t)(rend - p) >= 2 && p[0] == 0x0A &&
-               p[1] < 0x80) {
-      // MRU miss: the entry's own (single-byte) length varint names the
-      // candidate total length; probe the alternates for that shape.
-      uint32_t etot = 2u + p[1];
-      for (int a = 0; a < s.n_alts; a++) {
+    } else if (s.n_alts && (uint64_t)(rend - p) >= 2 && p[0] == 0x0A) {
+      // MRU miss: the entry's own length varint (1 or 2 bytes — entries
+      // up to ~16KB, e.g. long bytes values) names the candidate total
+      // length; probe the alternates for that shape. The memcmp verifies
+      // the full prefix, so the decoded length only preselects.
+      uint32_t etot = 0;
+      if (p[1] < 0x80) {
+        etot = 2u + p[1];
+      } else if ((uint64_t)(rend - p) >= 3 && p[2] < 0x80) {
+        etot = 3u + (((uint32_t)(p[1] & 0x7F)) | ((uint32_t)p[2] << 7));
+      }
+      for (int a = 0; etot && a < s.n_alts; a++) {
         SlotShape& v = s.alts[a];
         if (v.entry_total == etot && (uint64_t)(rend - p) >= etot &&
             std::memcmp(p, v.cache.data(), v.cache.size()) == 0) {
-          std::swap(s.mru, v);  // promote; old MRU stays as an alternate
+          if (TurboSlot::probe_reachable(s.mru.entry_total)) {
+            std::swap(s.mru, v);  // promote; old MRU stays as an alternate
+          } else {
+            // The outgoing MRU can never be probe-matched: dropping it
+            // (compact the set) keeps every alternate slot live instead
+            // of parking a dead shape the r3 guard exists to prevent.
+            s.mru = std::move(v);
+            if (a != --s.n_alts) v = std::move(s.alts[s.n_alts]);
+          }
           shape = &s.mru;
           break;
         }

@@ -211,11 +211,16 @@ def sparse_train_step(
     same effect from tf.IndexedSlices).
 
     Embedding rule: row-wise AdaGrad (the industry-standard DLRM choice —
-    one accumulator per ROW, not per element). Duplicate indices inside a
-    batch accumulate their row gradients exactly; their AdaGrad scale is
-    computed from the post-accumulation accumulator shared by the
-    duplicates (standard minibatch semantics). Non-embedding params go
-    through the wrapped optax transform unchanged.
+    one accumulator per ROW, not per element), with DEDUP-FIRST duplicate
+    semantics: indices repeated inside a batch first sum their row
+    gradients, then the accumulator adds mean((sum g)^2) ONCE per unique
+    row — exactly what dense row-wise AdaGrad on the full table gradient
+    does (and what TF IndexedSlices consumers / torchrec do). The dedup is
+    a sort + segment-sum over the B*F (feature, row) keys — O(B*F log)
+    on-device, trivial next to the table gather/scatter — with each unique
+    row's single contribution split evenly over its duplicates so plain
+    scatter-adds apply it exactly once. Non-embedding params go through
+    the wrapped optax transform unchanged.
 
     Jit this whole function (donate params + opt_state)."""
     table = params["embeddings"]                            # [F, V, D]
@@ -233,10 +238,38 @@ def sparse_train_step(
     updates, new_dense_state = tx.update(g_dense, opt_state.dense, dense_params)
     dense_params = jax.tree.map(lambda p, u: p + u, dense_params, updates)
     g_rows = g_rows.astype(jnp.float32)
-    row_ms = jnp.mean(g_rows * g_rows, axis=-1)             # [B, F]
-    accum = opt_state.accum.at[f_ix, idx].add(row_ms)
-    scale = embed_lr * jax.lax.rsqrt(accum[f_ix, idx] + embed_eps)  # [B, F]
-    table = table.at[f_ix, idx].add(-(scale[..., None] * g_rows))
+    fdim, vocab = cfg.num_categorical, cfg.vocab_size
+    d = g_rows.shape[-1]
+    n = idx.shape[0] * fdim
+    keys = (idx + f_ix * vocab).reshape(n)                  # [N] flat (f, v)
+    order = jnp.argsort(keys)
+    skeys = keys[order]
+    sg = g_rows.reshape(n, d)[order]
+    run_start = jnp.concatenate(
+        [jnp.ones((1,), bool), skeys[1:] != skeys[:-1]]
+    )
+    rid = jnp.cumsum(run_start) - 1                         # run id per element
+    # per-element view of its duplicate group's summed gradient and size
+    g_sum = jax.ops.segment_sum(
+        sg, rid, num_segments=n, indices_are_sorted=True
+    )[rid]                                                  # [N, D]
+    m = jax.ops.segment_sum(
+        jnp.ones((n,), jnp.float32), rid, num_segments=n, indices_are_sorted=True
+    )[rid]                                                  # [N]
+    inv_m = 1.0 / m
+    ms_share = jnp.mean(g_sum * g_sum, axis=-1) * inv_m     # sums to mean(G^2)
+    # Scatter with (f, v) index PAIRS, never a flattened [F*V] view: the
+    # table/accum keep their [F, V@model, D] layout, so GSPMD scatters into
+    # the model-sharded V axis instead of all-gathering a reshaped table
+    # (sorted keys => (f, v) pairs are lexicographically sorted too).
+    sf = skeys // vocab
+    sv = skeys - sf * vocab
+    accum = opt_state.accum.at[sf, sv].add(ms_share, indices_are_sorted=True)
+    # post-accumulation scale, shared by a row's duplicates by construction
+    scale = embed_lr * jax.lax.rsqrt(accum[sf, sv] + embed_eps)     # [N]
+    table = table.at[sf, sv].add(
+        -(scale * inv_m)[:, None] * g_sum, indices_are_sorted=True
+    )
     params = dict(dense_params, embeddings=table)
     return params, SparseEmbOptState(new_dense_state, accum), loss
 

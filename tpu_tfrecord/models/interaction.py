@@ -20,19 +20,18 @@ not the hot path for inference-heavy recommenders.
 `dot_interaction` picks the Pallas kernel on TPU backends and the XLA
 reference elsewhere (or under `interpret=True` for CPU tests).
 
-Performance (measured on one v5e chip, bf16; see PARITY.md for the full
-table and method): at DLRM-regime F (Criteo F=27) the kernel is at parity
-to ~1.2x vs XLA's fused einsum+gather in wall-clock microbenchmarks, and
-the op itself is tens of microseconds at B=8192 — a trivial slice of a
-training step either way. The selection-matmul formulation does
-~F/2 x the Gram FLOPs (two [F,P] one-hot contractions vs one [F,F] Gram),
-so it LOSES to XLA at F >= 64 even though the P-tiled grid keeps VMEM
-bounded; auto-dispatch therefore uses Pallas only for F <= 32 and XLA's
-path otherwise. The kernel's primary value is STRUCTURAL: keeping the
-Gram block VMEM-resident (no [B,F,F] HBM round-trip) and serving as the
-in-repo template for fusion kernels (P-tiled grid, matmul-instead-of-
-gather, custom VJP). Run ``tools/pallas_device_time.py`` on a TPU for
-dispatch-free device-time numbers (PARITY.md "Pallas kernel" section).
+RETIRED from auto-dispatch (round 4): dispatch-free DEVICE-TIME
+measurement on a real v5e chip (``tools/pallas_device_time.py``, fori_loop
+with a data-dependency carry, two-length delta, completion forced by a
+scalar fetch; full table in PARITY.md "Pallas kernel") shows XLA's
+einsum+gather is faster at EVERY F — Pallas/XLA device-time ratios at
+B=8192, D=32, bf16: F=8 0.27x, F=16 0.98x, F=27 0.89x, F=32 0.70x,
+F=64 0.46x. The selection-matmul formulation's ~F/2 x FLOP overhead (two
+[F,P] one-hot contractions vs one [F,F] Gram) costs more than the
+avoided [B,F,F] HBM round-trip saves at these sizes. ``dot_interaction``
+therefore defaults to the XLA path EVERYWHERE; the kernel remains as the
+in-repo TEMPLATE for fusion kernels (P-tiled grid, matmul-instead-of-
+gather, custom VJP) and is reachable only via ``use_pallas=True``.
 """
 
 from __future__ import annotations
@@ -154,26 +153,21 @@ def dot_interaction_pallas(
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
 def dot_interaction(emb: jax.Array, use_pallas: Optional[bool] = None,
                     block_b: int = 128, interpret: bool = False) -> jax.Array:
-    """Packed pairwise dots with autodiff; Pallas forward on TPU.
+    """Packed pairwise dots with autodiff.
 
-    Auto-dispatch (use_pallas=None) picks the kernel only on SINGLE-device
-    TPU backends: an un-annotated pallas_call inside a jit over a sharded
-    mesh would defeat GSPMD partitioning. Multi-chip users call it with
-    use_pallas=True from inside their own shard_map (per-device shapes).
+    Auto-dispatch (use_pallas=None) resolves to the XLA path everywhere:
+    measured device time on a real v5e shows XLA faster at every F (module
+    docstring / PARITY.md). The Pallas kernel is opt-in (use_pallas=True)
+    as a template; callers inside a shard_map pass it per-device shapes.
     """
     return _forward(emb, use_pallas, block_b, interpret)
 
 
 def _forward(emb, use_pallas, block_b, interpret):
     if use_pallas is None:
-        # F <= 32: the selection-matmul formulation's FLOP overhead
-        # (~F/2 x Gram) is small and the VMEM-resident Gram wins; beyond
-        # that XLA's einsum+gather is faster (module docstring).
-        use_pallas = (
-            jax.default_backend() == "tpu"
-            and jax.device_count() == 1
-            and emb.shape[1] <= 32
-        )
+        # Retired from auto-dispatch: v5e device-time table (PARITY.md)
+        # shows XLA's einsum+gather faster at every F measured.
+        use_pallas = False
     if use_pallas:
         return dot_interaction_pallas(emb, block_b=block_b, interpret=interpret)
     return dot_interaction_reference(emb)
