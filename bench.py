@@ -252,7 +252,13 @@ def seq_schema():
 
 def ensure_seq_dataset(data_dir: str) -> str:
     """Ragged SequenceExample dataset (long-doc shape: variable-length
-    frame lists of SEQ_DIM floats); generated once and cached."""
+    frame lists of SEQ_DIM floats); generated once and cached. The cache
+    key includes the SEQ_* generation parameters — changing them must
+    regenerate, not silently benchmark stale data of the wrong shape."""
+    data_dir = os.path.join(
+        data_dir,
+        f"s{SEQ_SHARDS}d{SEQ_DOCS_PER_SHARD}l{SEQ_MAX_LEN}f{SEQ_DIM}",
+    )
     if os.path.exists(os.path.join(data_dir, "_SUCCESS")):
         return data_dir
     from tpu_tfrecord.io.writer import DatasetWriter
@@ -300,12 +306,15 @@ def _seq_throughput(mesh, sharding_3d, seconds=4.0) -> dict:
         recordType="SequenceExample",
     )
     pad_to = {"frames": (SEQ_MAX_LEN, SEQ_DIM)}
+    # pad + f32->bf16 fused in the native kernel (tfr_pad_ragged2) — the
+    # dense f32 batch never materializes host-side
+    cast = {"frames": ml_dtypes.bfloat16}
     sharding_1d = data_sharding(mesh, ndim=1)
 
     def produce(cb):
-        hb = host_batch_from_columnar(cb, ds.schema, pad_to=pad_to)
+        hb = host_batch_from_columnar(cb, ds.schema, pad_to=pad_to, cast=cast)
         return {
-            "frames": hb["frames"].astype(ml_dtypes.bfloat16),
+            "frames": hb["frames"],
             "frames_len": hb["frames_len"],
             "label": hb["label"],
         }

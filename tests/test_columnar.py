@@ -196,6 +196,108 @@ class TestPadding:
         np.testing.assert_array_equal(outer_len, [2, 1])
         np.testing.assert_array_equal(inner_len, [[2, 1], [3, 0]])
 
+    @staticmethod
+    def _pad_ragged2_loop(values, inner_offsets, row_splits, max_outer,
+                          max_inner, pad_value=0):
+        """Per-row reference (the pre-vectorization implementation) — the
+        oracle the vectorized pad_ragged2 and the native fused kernel are
+        pinned against."""
+        outer_lengths = np.diff(row_splits)
+        n = len(outer_lengths)
+        dense = np.full((n, max_outer, max_inner), pad_value, dtype=values.dtype)
+        inner_len = np.zeros((n, max_outer), dtype=np.int32)
+        clipped = np.minimum(outer_lengths, max_outer).astype(np.int32)
+        for i in range(n):
+            for jo, j in enumerate(range(row_splits[i], row_splits[i] + clipped[i])):
+                seg = values[inner_offsets[j] : inner_offsets[j + 1]][:max_inner]
+                dense[i, jo, : len(seg)] = seg
+                inner_len[i, jo] = len(seg)
+        return dense, clipped, inner_len
+
+    def test_pad_ragged2_vectorized_matches_loop_oracle(self):
+        rng = np.random.default_rng(3)
+        for trial in range(8):
+            n = int(rng.integers(0, 40))
+            outer = rng.integers(0, 7, n)
+            splits = np.concatenate(([0], np.cumsum(outer))).astype(np.int64)
+            inner_lens = rng.integers(0, 9, int(splits[-1]))
+            inner = np.concatenate(([0], np.cumsum(inner_lens))).astype(np.int64)
+            values = rng.normal(size=int(inner[-1])).astype(np.float32)
+            lo = int(rng.integers(1, 9))
+            li = int(rng.integers(1, 11))
+            pad = float(rng.choice([0.0, -1.0]))
+            got = pad_ragged2(values, inner, splits, lo, li, pad_value=pad)
+            ref = self._pad_ragged2_loop(values, inner, splits, lo, li, pad_value=pad)
+            for g, r in zip(got, ref):
+                np.testing.assert_array_equal(g, r, err_msg=f"trial {trial}")
+
+    def test_pad_ragged2_native_fused_matches_numpy(self):
+        from tpu_tfrecord import _native
+
+        if not _native.available():
+            pytest.skip("native lib unavailable")
+        import ml_dtypes
+
+        rng = np.random.default_rng(5)
+        outer = rng.integers(0, 7, 50)
+        splits = np.concatenate(([0], np.cumsum(outer))).astype(np.int64)
+        inner_lens = rng.integers(0, 9, int(splits[-1]))
+        inner = np.concatenate(([0], np.cumsum(inner_lens))).astype(np.int64)
+        values = rng.normal(size=int(inner[-1])).astype(np.float32)
+        if len(values) >= 3:  # bf16 rounding + special values go through C++
+            values[0] = np.nan
+            values[1] = np.inf
+            values[2] = np.float32(3.0000001)
+        ref_dense, ref_ol, ref_il = pad_ragged2(values, inner, splits, 5, 7)
+        got = _native.pad_ragged2_dense(values, inner, splits, 5, 7, None)
+        assert got is not None
+        np.testing.assert_array_equal(got[0], ref_dense)
+        np.testing.assert_array_equal(got[1], ref_ol)
+        np.testing.assert_array_equal(got[2], ref_il)
+        # fused bf16 == pad-then-astype (round-to-nearest-even, NaN stays NaN)
+        got_b = _native.pad_ragged2_dense(
+            values, inner, splits, 5, 7, ml_dtypes.bfloat16
+        )
+        ref_b = ref_dense.astype(ml_dtypes.bfloat16)
+        same = (got_b[0] == ref_b) | (
+            np.isnan(got_b[0].astype(np.float32)) & np.isnan(ref_b.astype(np.float32))
+        )
+        assert same.all()
+        # int64 source: i64 passthrough and i32 two's-complement truncation
+        vi = rng.integers(-(2**40), 2**40, int(inner[-1])).astype(np.int64)
+        ref_i, _, _ = pad_ragged2(vi, inner, splits, 5, 7)
+        got_i64 = _native.pad_ragged2_dense(vi, inner, splits, 5, 7, np.int64)
+        got_i32 = _native.pad_ragged2_dense(vi, inner, splits, 5, 7, np.int32)
+        np.testing.assert_array_equal(got_i64[0], ref_i)
+        np.testing.assert_array_equal(got_i32[0], ref_i.astype(np.int32))
+        # non-zero pad_value is numpy-only: native reports unsupported
+        assert (
+            _native.pad_ragged2_dense(values, inner, splits, 5, 7, None, pad_value=-1)
+            is None
+        )
+
+    def test_pad_ragged_native_fused_matches_numpy(self):
+        from tpu_tfrecord import _native
+
+        if not _native.available():
+            pytest.skip("native lib unavailable")
+        import ml_dtypes
+
+        rng = np.random.default_rng(6)
+        lens = rng.integers(0, 9, 64)
+        offsets = np.concatenate(([0], np.cumsum(lens))).astype(np.int64)
+        values = rng.normal(size=int(offsets[-1])).astype(np.float32)
+        ref_dense, ref_len = pad_ragged(values, offsets, 5)
+        got = _native.pad_ragged_dense(values, offsets, 5, None)
+        assert got is not None
+        np.testing.assert_array_equal(got[0], ref_dense)
+        np.testing.assert_array_equal(got[1], ref_len)
+        got_b = _native.pad_ragged_dense(values, offsets, 5, ml_dtypes.bfloat16)
+        np.testing.assert_array_equal(
+            got_b[0].astype(np.float32),
+            ref_dense.astype(ml_dtypes.bfloat16).astype(np.float32),
+        )
+
     def test_bucket_boundaries(self):
         bounds = bucket_boundaries([1, 2, 3, 4, 100], num_buckets=2)
         assert bounds[-1] == 100

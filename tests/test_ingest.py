@@ -277,6 +277,57 @@ class TestSequenceIngest:
         np.testing.assert_allclose(row0[0, :2], [1.0, 2.0])
         np.testing.assert_allclose(row0[1, 0], 3.0)
 
+    def test_cast_fused_pad_bf16(self, sandbox):
+        """``cast`` emits frames in bf16 (fused native pad+cast, numpy
+        fallback) with values equal to pad-then-astype, and batch_spec
+        reflects the override."""
+        import ml_dtypes
+
+        from tpu_tfrecord.tpu.ingest import batch_spec
+
+        schema = StructType(
+            [
+                StructField("id", LongType()),
+                StructField("frames", ArrayType(ArrayType(FloatType()))),
+            ]
+        )
+        rows = [
+            [0, [[1.5, 2.25], [3.0]]],
+            [1, [[4.0, 5.0, 6.0]]],
+            [2, [[7.0]]],
+            [3, [[8.0], [9.0], [10.0]]],
+        ]
+        out = str(sandbox / "seqcast")
+        tfio.write(rows, schema, out, mode="overwrite", recordType="SequenceExample")
+        ds = TFRecordDataset(
+            out, batch_size=4, schema=schema, recordType="SequenceExample"
+        )
+        pad_to = {"frames": (4, 4)}
+        cast = {"frames": ml_dtypes.bfloat16}
+        with ds.batches() as it:
+            cb = next(it)
+        plain = host_batch_from_columnar(cb, ds.schema, pad_to=pad_to)
+        casted = host_batch_from_columnar(cb, ds.schema, pad_to=pad_to, cast=cast)
+        assert casted["frames"].dtype == ml_dtypes.bfloat16
+        np.testing.assert_array_equal(
+            casted["frames"].astype(np.float32),
+            plain["frames"].astype(ml_dtypes.bfloat16).astype(np.float32),
+        )
+        np.testing.assert_array_equal(casted["frames_len"], plain["frames_len"])
+        np.testing.assert_array_equal(
+            casted["frames_inner_len"], plain["frames_inner_len"]
+        )
+        spec = batch_spec(ds.schema, 4, pad_to=pad_to, cast=cast)
+        assert spec["frames"].dtype == ml_dtypes.bfloat16
+        assert spec["frames"].shape == (4, 4, 4)
+        # typo'd cast key errors eagerly (mirrors validate_hash_buckets)
+        with pytest.raises(ValueError, match="no castable data column"):
+            host_batch_from_columnar(
+                cb, ds.schema, pad_to=pad_to, cast={"frame": ml_dtypes.bfloat16}
+            )
+        with pytest.raises(ValueError, match="no castable data column"):
+            batch_spec(ds.schema, 4, pad_to=pad_to, cast={"frame": ml_dtypes.bfloat16})
+
 
 def _heavy_step(scan_length):
     """A device step of tunable weight: matmul chain via lax.scan, seeded

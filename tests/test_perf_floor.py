@@ -33,6 +33,12 @@ from tpu_tfrecord.schema import (
 from tpu_tfrecord.serde import TFRecordSerializer, encode_row
 
 FLOOR = float(os.environ.get("TFR_PERF_FLOOR_EX_S", 500_000))
+# SequenceExample floor: the bench box measures ~250k ex/s on the fused
+# native pad+bf16 path ([B, 64, 16] frames); 80k holds the same ~3x slack
+# as the Criteo floor while tripping on the regression classes that matter
+# here: fused pad kernel lost (falls back through numpy, and a further fall
+# to any per-row path lands at ~16k).
+SEQ_FLOOR = float(os.environ.get("TFR_SEQ_PERF_FLOOR_EX_S", 80_000))
 N_RECORDS = 16384
 BATCH = 4096
 
@@ -56,6 +62,7 @@ def _write_criteo_shard(path: str, n: int) -> None:
     wire.write_records(path, rows())
 
 
+@pytest.mark.perf
 @pytest.mark.skipif(not _native.available(), reason="native decoder unavailable")
 def test_criteo_decode_hash_pack_floor(tmp_path):
     from tpu_tfrecord.tpu import host_batch_from_columnar
@@ -101,4 +108,81 @@ def test_criteo_decode_hash_pack_floor(tmp_path):
         f"device-free decode+hash+pack throughput {best:,.0f} ex/s fell "
         f"below the floor {FLOOR:,.0f} ex/s — decode-path regression "
         "(native disabled? turbo cache broken? per-batch copies?)"
+    )
+
+
+SEQ_MAX_LEN = 64
+SEQ_DIM = 16
+SEQ_BATCH = 1024
+
+
+def _write_seq_shard(path: str, n: int) -> None:
+    from tpu_tfrecord.schema import ArrayType, FloatType
+
+    fields = [
+        StructField("label", LongType(), nullable=False),
+        StructField("frames", ArrayType(ArrayType(FloatType()))),
+    ]
+    ser = TFRecordSerializer(StructType(fields))
+    rng = np.random.default_rng(1)
+
+    def rows():
+        for r in range(n):
+            ln = int(rng.integers(8, SEQ_MAX_LEN + 1))
+            frames = rng.normal(size=(ln, SEQ_DIM)).astype(np.float32)
+            yield encode_row(
+                ser,
+                RecordType.SEQUENCE_EXAMPLE,
+                [r & 1, [row.tolist() for row in frames]],
+            )
+
+    wire.write_records(path, rows())
+
+
+@pytest.mark.perf
+@pytest.mark.skipif(not _native.available(), reason="native decoder unavailable")
+def test_sequence_pad_bf16_floor(tmp_path):
+    """Floor for the SequenceExample host path (VERDICT r4 item 1): ragged^2
+    decode + fused native pad+bf16 ([B, 64, 16] frames). Without this, a
+    regression on half the reference's record-type surface
+    (TFRecordDeserializer.scala:37-61) is invisible until a bench round."""
+    import ml_dtypes
+
+    from tpu_tfrecord.schema import ArrayType, FloatType
+    from tpu_tfrecord.tpu import host_batch_from_columnar
+
+    for s in range(2):
+        _write_seq_shard(str(tmp_path / f"part-{s:05d}.tfrecord"), 8192)
+    schema = StructType([
+        StructField("label", LongType(), nullable=False),
+        StructField("frames", ArrayType(ArrayType(FloatType()))),
+    ])
+    pad_to = {"frames": (SEQ_MAX_LEN, SEQ_DIM)}
+    cast = {"frames": ml_dtypes.bfloat16}
+    ds = TFRecordDataset(
+        str(tmp_path),
+        batch_size=SEQ_BATCH,
+        schema=schema,
+        prefetch=4,
+        num_epochs=None,
+        recordType="SequenceExample",
+    )
+    best = 0.0
+    with ds.batches() as it:
+        for _ in range(3):
+            host_batch_from_columnar(next(it), ds.schema, pad_to=pad_to, cast=cast)
+        for _ in range(3):
+            t0 = time.perf_counter()
+            n = 0
+            while time.perf_counter() - t0 < 0.5:
+                hb = host_batch_from_columnar(
+                    next(it), ds.schema, pad_to=pad_to, cast=cast
+                )
+                n += hb["frames"].shape[0]
+            best = max(best, n / (time.perf_counter() - t0))
+    assert hb["frames"].dtype == ml_dtypes.bfloat16
+    assert best >= SEQ_FLOOR, (
+        f"SequenceExample decode+pad+bf16 throughput {best:,.0f} ex/s fell "
+        f"below the floor {SEQ_FLOOR:,.0f} ex/s — ragged^2 path regression "
+        "(fused native pad lost? per-row padding reintroduced?)"
     )

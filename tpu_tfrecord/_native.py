@@ -137,6 +137,17 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
         ctypes.c_int32, i32p,
     ]
+    lib.tfr_pad_ragged.restype = ctypes.c_int64
+    lib.tfr_pad_ragged.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, i64p, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p, i32p,
+    ]
+    lib.tfr_pad_ragged2.restype = ctypes.c_int64
+    lib.tfr_pad_ragged2.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, i64p, i64p, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
+        i32p, i32p,
+    ]
     lib.tfr_snappy_decompress.restype = ctypes.c_int64
     lib.tfr_snappy_decompress.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64, u8p, ctypes.c_uint64
@@ -711,6 +722,117 @@ def pack_mixed(arr: np.ndarray, keep: int, bits: int) -> Optional[np.ndarray]:
     return out
 
 
+# Fused pad+cast kind tables (mirror tfr_pad_ragged/_ragged2's contract).
+# bf16 output uses ml_dtypes.bfloat16 as the numpy dtype; imported lazily so
+# the wrapper stays importable where ml_dtypes is absent.
+_PAD_IN_KINDS = {np.dtype(np.float32): 0, np.dtype(np.int64): 1}
+
+
+def _pad_out_kind(in_kind: int, out_dtype) -> Optional[int]:
+    dt = np.dtype(out_dtype)
+    if in_kind == 0:
+        if dt == np.float32:
+            return 0
+        if dt.name == "bfloat16":
+            return 1
+    else:
+        if dt == np.int64:
+            return 2
+        if dt == np.int32:
+            return 3
+    return None
+
+
+def pad_ragged_dense(values, offsets, max_len, out_dtype=None, pad_value=0):
+    """Native fused pad(+cast): ragged [total]+offsets -> dense [N, max_len]
+    + clipped lengths [N] int32. None when unavailable/unsupported (caller
+    falls back to columnar.pad_ragged + astype)."""
+    lib = load()
+    if lib is None or pad_value != 0:
+        return None
+    values = np.ascontiguousarray(values)
+    in_kind = _PAD_IN_KINDS.get(values.dtype)
+    if in_kind is None:
+        return None
+    out_dtype = values.dtype if out_dtype is None else np.dtype(out_dtype)
+    out_kind = _pad_out_kind(in_kind, out_dtype)
+    if out_kind is None:
+        return None
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = len(offsets) - 1
+    if n and offsets[-1] > len(values):
+        # The kernel is offset-driven with no values-length parameter; keep
+        # the numpy path's failure mode instead of reading out of bounds.
+        raise IndexError(
+            f"pad_ragged offsets end at {int(offsets[-1])} but values has "
+            f"{len(values)} elements"
+        )
+    dense = np.empty((n, max_len), dtype=out_dtype)
+    lengths = np.empty(n, dtype=np.int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    rc = lib.tfr_pad_ragged(
+        values.ctypes.data_as(ctypes.c_void_p), in_kind,
+        offsets.ctypes.data_as(i64p), n, max_len, out_kind,
+        dense.ctypes.data_as(ctypes.c_void_p),
+        lengths.ctypes.data_as(i32p),
+    )
+    if rc != 0:  # pragma: no cover - kinds validated above
+        return None
+    return dense, lengths
+
+
+def pad_ragged2_dense(
+    values, inner_offsets, row_splits, max_outer, max_inner,
+    out_dtype=None, pad_value=0,
+):
+    """Native fused pad(+cast): ragged^2 buffers -> dense [N, Lo, Li] +
+    outer lengths [N] + inner lengths [N, Lo] (both int32). None when
+    unavailable/unsupported (caller falls back to columnar.pad_ragged2)."""
+    lib = load()
+    if lib is None or pad_value != 0:
+        return None
+    values = np.ascontiguousarray(values)
+    in_kind = _PAD_IN_KINDS.get(values.dtype)
+    if in_kind is None:
+        return None
+    out_dtype = values.dtype if out_dtype is None else np.dtype(out_dtype)
+    out_kind = _pad_out_kind(in_kind, out_dtype)
+    if out_kind is None:
+        return None
+    inner_offsets = np.ascontiguousarray(inner_offsets, dtype=np.int64)
+    row_splits = np.ascontiguousarray(row_splits, dtype=np.int64)
+    n = len(row_splits) - 1
+    # Offset-driven kernel, no length parameters: keep the numpy path's
+    # IndexError on inconsistent buffers instead of reading out of bounds.
+    if n and row_splits[-1] > len(inner_offsets) - 1:
+        raise IndexError(
+            f"pad_ragged2 row_splits end at {int(row_splits[-1])} but "
+            f"inner_offsets describes {len(inner_offsets) - 1} lists"
+        )
+    if len(inner_offsets) > 1 and inner_offsets[-1] > len(values):
+        raise IndexError(
+            f"pad_ragged2 inner_offsets end at {int(inner_offsets[-1])} but "
+            f"values has {len(values)} elements"
+        )
+    dense = np.empty((n, max_outer, max_inner), dtype=out_dtype)
+    outer_len = np.empty(n, dtype=np.int32)
+    inner_len = np.empty((n, max_outer), dtype=np.int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    rc = lib.tfr_pad_ragged2(
+        values.ctypes.data_as(ctypes.c_void_p), in_kind,
+        inner_offsets.ctypes.data_as(i64p),
+        row_splits.ctypes.data_as(i64p), n, max_outer, max_inner, out_kind,
+        dense.ctypes.data_as(ctypes.c_void_p),
+        outer_len.ctypes.data_as(i32p),
+        inner_len.ctypes.data_as(i32p),
+    )
+    if rc != 0:  # pragma: no cover - kinds validated above
+        return None
+    return dense, outer_len, inner_len
+
+
 # A valid snappy stream expands at most ~21x per compressed byte (a 3-byte
 # copy2 element emits up to 64 bytes); far beyond that, the length varint
 # is corrupt — refuse BEFORE allocating what untrusted bytes claim.
@@ -800,9 +922,12 @@ def snappy_compress(data: bytes) -> Optional[bytes]:
 
 def lz4_compress(data: bytes) -> Optional[bytes]:
     """Native lz4-block ENCODE (greedy hash matcher, 64KB offset window);
-    None if the native lib is unavailable."""
+    None if the native lib is unavailable or the input exceeds the
+    kernel's int32 match-table contract (callers frame in 256 KiB Hadoop
+    blocks, so a >=2 GiB single call is out of contract — the pure-Python
+    fallback handles it instead of silently degrading)."""
     lib = load()
-    if lib is None:
+    if lib is None or len(data) > 2**31 - 1:
         return None
     cap = lib.tfr_lz4_max_compressed(len(data))
     out = np.empty(cap, dtype=np.uint8)

@@ -635,6 +635,8 @@ def pad_ragged2(
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Two-level ragged -> dense [N, max_outer, max_inner] + outer lengths
     [N] + inner lengths [N, max_outer]."""
+    row_splits = np.asarray(row_splits)
+    inner_offsets = np.asarray(inner_offsets)
     outer_lengths = np.diff(row_splits)
     n = len(outer_lengths)
     if max_outer is None:
@@ -645,11 +647,27 @@ def pad_ragged2(
     dense = np.full((n, max_outer, max_inner), pad_value, dtype=values.dtype)
     inner_len_out = np.zeros((n, max_outer), dtype=np.int32)
     clipped_outer = np.minimum(outer_lengths, max_outer).astype(np.int32)
-    for i in range(n):
-        for jo, j in enumerate(range(row_splits[i], row_splits[i] + clipped_outer[i])):
-            seg = values[inner_offsets[j] : inner_offsets[j + 1]][:max_inner]
-            dense[i, jo, : len(seg)] = seg
-            inner_len_out[i, jo] = len(seg)
+    if n and max_outer and max_inner:
+        # Fully vectorized two-level pad (no per-row Python loop — that costs
+        # ~75 ms/batch at the long-doc bench shape): select the kept inner
+        # lists row-major with their destination (row, slot), then apply the
+        # one-level pad gather over just those lists and scatter into the
+        # flattened [n * max_outer, max_inner] dense view.
+        slot = np.arange(max_outer)
+        keep = slot[None, :] < clipped_outer[:, None]          # [n, max_outer]
+        flat_lists = (row_splits[:-1, None] + slot[None, :])[keep]
+        dest = (np.arange(n)[:, None] * max_outer + slot[None, :])[keep]
+        starts = inner_offsets[flat_lists]
+        clipped_inner = np.minimum(
+            inner_lengths_flat[flat_lists], max_inner
+        ).astype(np.int32)
+        col_idx = np.arange(max_inner)[None, :]
+        valid = col_idx < clipped_inner[:, None]               # [kept, max_inner]
+        dense2 = dense.reshape(n * max_outer, max_inner)
+        sub = np.full((len(flat_lists), max_inner), pad_value, dtype=values.dtype)
+        sub[valid] = values[(starts[:, None] + col_idx)[valid]]
+        dense2[dest] = sub
+        inner_len_out.reshape(-1)[dest] = clipped_inner
     return dense, clipped_outer, inner_len_out
 
 

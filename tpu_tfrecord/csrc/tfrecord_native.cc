@@ -2075,6 +2075,11 @@ int64_t tfr_lz4_max_compressed(uint64_t n) {
 int64_t tfr_lz4_compress(const uint8_t* src, uint64_t n, uint8_t* dst,
                          uint64_t dst_cap) {
   if ((int64_t)dst_cap < tfr_lz4_max_compressed(n)) return -2;
+  // The match table stores int32 positions: beyond 2 GiB positions alias
+  // (output would stay valid — matches are byte-verified — but the ratio
+  // collapses silently). Callers frame in 256 KiB Hadoop blocks; refuse
+  // the out-of-contract single-call case instead of degrading.
+  if (n > (uint64_t)INT32_MAX) return -2;
   uint8_t* d = dst;
   const uint8_t* iend = src + n;
   const uint8_t* ip = src;
@@ -2180,6 +2185,105 @@ int64_t tfr_pack_mixed(const int32_t* in, int64_t n_rows, int32_t n_cols,
     while (o < dst + keep + w) *o++ = 0;
   }
   return -1;
+}
+
+// ---------------------------------------------------------------------------
+// Fused ragged -> dense padding (+ dtype cast)
+// ---------------------------------------------------------------------------
+// The host tail of SequenceExample ingest (ref TFRecordDeserializer.scala:
+// 37-61's 2-D FeatureLists): the decoder produces ragged value buffers, the
+// device wants dense [B, Lo, Li] in the compute dtype. Doing pad + cast in
+// numpy costs ~75 ms/batch at the bench shape (per-row Python loop +
+// ml_dtypes cast); fused here it is a memset + per-list memcpy/convert.
+// in_kind: 0 = f32, 1 = i64. out_kind: 0 = f32, 1 = bf16 (from f32,
+// round-to-nearest-even), 2 = i64, 3 = i32 (from i64, two's-complement
+// truncation — Scala Long.toInt semantics like the scalar path).
+
+static inline uint16_t f32_to_bf16_rne(uint32_t u) {
+  if ((u & 0x7fffffffu) > 0x7f800000u)  // NaN: keep quiet, keep payload bit
+    return (uint16_t)((u >> 16) | 0x0040u);
+  u += 0x7fffu + ((u >> 16) & 1u);
+  return (uint16_t)(u >> 16);
+}
+
+// Copy one run of li elements from src[v0..] to dst, converting per the
+// (in_kind, out_kind) pair. Returns false for an unsupported combo.
+static inline bool pad_copy_run(const void* values, int64_t v0, int64_t li,
+                                int32_t in_kind, int32_t out_kind,
+                                void* dst) {
+  if (in_kind == 0 && out_kind == 0) {
+    std::memcpy(dst, (const float*)values + v0, (size_t)li * 4);
+  } else if (in_kind == 0 && out_kind == 1) {
+    const uint32_t* src = (const uint32_t*)values + v0;
+    uint16_t* d = (uint16_t*)dst;
+    for (int64_t k = 0; k < li; k++) d[k] = f32_to_bf16_rne(src[k]);
+  } else if (in_kind == 1 && out_kind == 2) {
+    std::memcpy(dst, (const int64_t*)values + v0, (size_t)li * 8);
+  } else if (in_kind == 1 && out_kind == 3) {
+    const int64_t* src = (const int64_t*)values + v0;
+    int32_t* d = (int32_t*)dst;
+    for (int64_t k = 0; k < li; k++) d[k] = (int32_t)src[k];
+  } else {
+    return false;
+  }
+  return true;
+}
+
+static inline size_t pad_out_esize(int32_t out_kind) {
+  return out_kind == 1 ? 2 : out_kind == 2 ? 8 : 4;
+}
+
+// One-level ragged [total] + offsets [n_rows+1] -> dense [n_rows, max_len]
+// (pad 0) + clipped lengths [n_rows]. Returns 0, or -1 on bad kind combo.
+int64_t tfr_pad_ragged(const void* values, int32_t in_kind,
+                       const int64_t* offsets, int64_t n_rows,
+                       int64_t max_len, int32_t out_kind, void* dense,
+                       int32_t* lengths) {
+  const size_t esz = pad_out_esize(out_kind);
+  std::memset(dense, 0, (size_t)(n_rows * max_len) * esz);
+  for (int64_t i = 0; i < n_rows; i++) {
+    const int64_t v0 = offsets[i];
+    int64_t li = offsets[i + 1] - v0;
+    if (li > max_len) li = max_len;
+    lengths[i] = (int32_t)li;
+    if (li && !pad_copy_run(values, v0, li, in_kind, out_kind,
+                            (uint8_t*)dense + (size_t)(i * max_len) * esz))
+      return -1;
+  }
+  return 0;
+}
+
+// Two-level ragged -> dense [n_rows, max_outer, max_inner] (pad 0) +
+// outer lengths [n_rows] + inner lengths [n_rows, max_outer] (zero beyond
+// each row's outer length). Rows/lists beyond the max are truncated, the
+// same contract as columnar.pad_ragged2. Returns 0, or -1 on bad kinds.
+int64_t tfr_pad_ragged2(const void* values, int32_t in_kind,
+                        const int64_t* inner_offsets,
+                        const int64_t* row_splits, int64_t n_rows,
+                        int64_t max_outer, int64_t max_inner,
+                        int32_t out_kind, void* dense, int32_t* outer_len,
+                        int32_t* inner_len) {
+  const size_t esz = pad_out_esize(out_kind);
+  const int64_t cell = max_outer * max_inner;
+  std::memset(dense, 0, (size_t)(n_rows * cell) * esz);
+  std::memset(inner_len, 0, (size_t)(n_rows * max_outer) * 4);
+  for (int64_t i = 0; i < n_rows; i++) {
+    const int64_t lo_full = row_splits[i + 1] - row_splits[i];
+    const int64_t lo = lo_full < max_outer ? lo_full : max_outer;
+    outer_len[i] = (int32_t)lo;
+    for (int64_t jo = 0; jo < lo; jo++) {
+      const int64_t j = row_splits[i] + jo;
+      const int64_t v0 = inner_offsets[j];
+      int64_t li = inner_offsets[j + 1] - v0;
+      if (li > max_inner) li = max_inner;
+      inner_len[i * max_outer + jo] = (int32_t)li;
+      if (li && !pad_copy_run(values, v0, li, in_kind, out_kind,
+                              (uint8_t*)dense +
+                                  (size_t)(i * cell + jo * max_inner) * esz))
+        return -1;
+    }
+  }
+  return 0;
 }
 
 }  // extern "C"

@@ -239,6 +239,16 @@ def sparse_train_step(
     dense_params = jax.tree.map(lambda p, u: p + u, dense_params, updates)
     g_rows = g_rows.astype(jnp.float32)
     fdim, vocab = cfg.num_categorical, cfg.vocab_size
+    if fdim * vocab > jnp.iinfo(jnp.int32).max:
+        # int32 flat keys (the default JAX index dtype with x64 disabled)
+        # would silently wrap for F*V > 2^31, merging unrelated rows into
+        # one dedup group and corrupting their updates. Vocabularies that
+        # large should shard the table (param_shardings model axis) or
+        # enable jax_enable_x64 and widen the key computation.
+        raise ValueError(
+            f"sparse_train_step: num_categorical * vocab_size = "
+            f"{fdim * vocab} exceeds int32 range for flat dedup keys"
+        )
     d = g_rows.shape[-1]
     n = idx.shape[0] * fdim
     keys = (idx + f_ix * vocab).reshape(n)                  # [N] flat (f, v)

@@ -42,12 +42,28 @@ def _is_bytes_like(dt: DataType) -> bool:
     return False
 
 
+def _validate_cast(schema: StructType, cast: Dict[str, np.dtype]) -> None:
+    """Every cast key must name a numeric schema column — a typo'd name
+    would otherwise silently skip the cast (mirrors validate_hash_buckets'
+    eager unknown-column error)."""
+    castable = {
+        f.name for f in schema if not _is_bytes_like(f.data_type)
+    }
+    for name in cast:
+        if name not in castable:
+            raise ValueError(
+                f"cast: no castable data column named {name!r} "
+                f"(numeric columns: {sorted(castable)})"
+            )
+
+
 def batch_spec(
     schema: StructType,
     batch_size: int,
     pad_to: Optional[Dict[str, Union[int, tuple]]] = None,
     hash_buckets: Optional[Dict[str, int]] = None,
     include_lengths: bool = True,
+    cast: Optional[Dict[str, np.dtype]] = None,
 ) -> Dict[str, jax.ShapeDtypeStruct]:
     """Schema -> pytree of ShapeDtypeStruct for one global batch.
 
@@ -61,10 +77,19 @@ def batch_spec(
                                           half the transfer bytes of int64)
     ``pad_to`` must give L (or (Lo, Li)) for every ragged column — static
     shapes are what let XLA tile the downstream compute onto the MXU.
+    ``cast`` overrides a column's device dtype (e.g. ``{"frames":
+    ml_dtypes.bfloat16}`` — halves link bytes; the fused native pad+cast
+    makes it free on the host side).
     """
     pad_to = pad_to or {}
     hash_buckets = hash_buckets or {}
+    cast = cast or {}
+    _validate_cast(schema, cast)
     spec: Dict[str, jax.ShapeDtypeStruct] = {}
+
+    def col_dtype(name: str, dt: DataType):
+        return np.dtype(cast[name]) if name in cast else numpy_dtype(dt)
+
     for f in schema:
         dt = f.data_type
         if _is_bytes_like(dt):
@@ -83,7 +108,7 @@ def batch_spec(
             if isinstance(dt.element_type, ArrayType):
                 lo, li = pad_to[f.name]
                 spec[f.name] = jax.ShapeDtypeStruct(
-                    (batch_size, lo, li), numpy_dtype(dt)
+                    (batch_size, lo, li), col_dtype(f.name, dt)
                 )
                 if include_lengths:
                     spec[f.name + "_len"] = jax.ShapeDtypeStruct((batch_size,), np.int32)
@@ -92,11 +117,13 @@ def batch_spec(
                     )
             else:
                 length = pad_to[f.name]
-                spec[f.name] = jax.ShapeDtypeStruct((batch_size, length), numpy_dtype(dt))
+                spec[f.name] = jax.ShapeDtypeStruct(
+                    (batch_size, length), col_dtype(f.name, dt)
+                )
                 if include_lengths:
                     spec[f.name + "_len"] = jax.ShapeDtypeStruct((batch_size,), np.int32)
         else:
-            spec[f.name] = jax.ShapeDtypeStruct((batch_size,), numpy_dtype(dt))
+            spec[f.name] = jax.ShapeDtypeStruct((batch_size,), col_dtype(f.name, dt))
     return spec
 
 
@@ -131,6 +158,38 @@ def hash_bytes_column(col_or_blobs, num_buckets: int) -> np.ndarray:
     return out
 
 
+def _pad_ragged_cast(col: Column, max_len: int, out_dtype) -> tuple:
+    """One-level pad with optional dtype cast, native-fused when possible."""
+    from tpu_tfrecord import _native
+
+    if _native.available():
+        res = _native.pad_ragged_dense(col.values, col.offsets, max_len, out_dtype)
+        if res is not None:
+            return res
+    dense, lengths = pad_ragged(col.values, col.offsets, max_len)
+    if out_dtype is not None and dense.dtype != np.dtype(out_dtype):
+        dense = dense.astype(out_dtype)
+    return dense, lengths
+
+
+def _pad_ragged2_cast(col: Column, lo: int, li: int, out_dtype) -> tuple:
+    """Two-level pad with optional dtype cast, native-fused when possible."""
+    from tpu_tfrecord import _native
+
+    if _native.available():
+        res = _native.pad_ragged2_dense(
+            col.values, col.inner_offsets, col.offsets, lo, li, out_dtype
+        )
+        if res is not None:
+            return res
+    dense, outer_len, inner_len = pad_ragged2(
+        col.values, col.inner_offsets, col.offsets, lo, li
+    )
+    if out_dtype is not None and dense.dtype != np.dtype(out_dtype):
+        dense = dense.astype(out_dtype)
+    return dense, outer_len, inner_len
+
+
 def host_batch_from_columnar(
     batch: ColumnarBatch,
     schema: StructType,
@@ -138,6 +197,7 @@ def host_batch_from_columnar(
     hash_buckets: Optional[Dict[str, int]] = None,
     include_lengths: bool = True,
     pack: Optional[Dict[str, List[str]]] = None,
+    cast: Optional[Dict[str, np.dtype]] = None,
 ) -> Dict[str, np.ndarray]:
     """ColumnarBatch -> dict of dense numpy arrays matching batch_spec.
 
@@ -145,9 +205,15 @@ def host_batch_from_columnar(
     (``{"dense": ["I1", ...], "cat": ["C1", ...]}``) — fewer, larger
     device transfers (one dispatch per group instead of per column) and the
     natural layout for MXU-bound consumers like the DLRM model.
+
+    ``cast`` maps column name -> output dtype (e.g. bfloat16 for float
+    frames). For ragged columns the pad and the cast run fused in the native
+    kernel — the f32->bf16 conversion never materializes an f32 dense batch.
     """
     pad_to = pad_to or {}
     hash_buckets = hash_buckets or {}
+    cast = cast or {}
+    _validate_cast(schema, cast)
     out: Dict[str, np.ndarray] = {}
     # Groups already materialized by the native decoder (pack pushed down):
     # take their matrices directly and skip the member fields.
@@ -209,8 +275,8 @@ def host_batch_from_columnar(
         if isinstance(dt, ArrayType):
             if isinstance(dt.element_type, ArrayType):
                 lo, li = pad_to[f.name]
-                dense, outer_len, inner_len = pad_ragged2(
-                    col.values, col.inner_offsets, col.offsets, lo, li
+                dense, outer_len, inner_len = _pad_ragged2_cast(
+                    col, lo, li, cast.get(f.name)
                 )
                 out[f.name] = dense
                 if include_lengths:
@@ -225,12 +291,17 @@ def host_batch_from_columnar(
                     raise ValueError(
                         f"ragged column {f.name!r} requires pad_to[{f.name!r}]"
                     )
-                dense, lengths = pad_ragged(col.values, col.offsets, pad_to[f.name])
+                dense, lengths = _pad_ragged_cast(
+                    col, pad_to[f.name], cast.get(f.name)
+                )
                 out[f.name] = dense
                 if include_lengths:
                     out[f.name + "_len"] = lengths
         else:
-            out[f.name] = col.values
+            vals = col.values
+            if f.name in cast and vals.dtype != np.dtype(cast[f.name]):
+                vals = vals.astype(cast[f.name])
+            out[f.name] = vals
     if pack:
         for group, names in pack.items():
             if group in out:
