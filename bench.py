@@ -206,16 +206,48 @@ def _cold_io_throughput(data_dir, schema, hash_buckets, pack) -> dict:
         data_dir, schema, hash_buckets, pack,
         num_epochs=1, num_workers=workers, readahead_bytes=readahead,
     )
+    # Stage attribution (VERDICT r4 item 2): process CPU time vs wall tells
+    # IO-stalled from CPU-bound; consumer-side wait/pack and the decode
+    # stage's per-worker seconds (sums across threads, so it can exceed
+    # wall when overlap works) localize where the wall time went; majflt ~ 0
+    # proves the WILLNEED readahead turned cold reads into prefetched
+    # (minor-fault) hits.
+    import resource
+
+    from tpu_tfrecord.metrics import METRICS
+
+    d0 = METRICS.stage("decode").seconds
+    r0 = resource.getrusage(resource.RUSAGE_SELF)
     t0 = time.perf_counter()
     n = 0
+    wait_s = 0.0
+    pack_s = 0.0
     with ds.batches() as it:
-        for cb in it:
+        while True:
+            w0 = time.perf_counter()
+            cb = next(it, None)
+            wait_s += time.perf_counter() - w0
+            if cb is None:
+                break
+            p0 = time.perf_counter()
             hb = host_batch_from_columnar(
                 cb, ds.schema, hash_buckets=hash_buckets, pack=pack
             )
+            pack_s += time.perf_counter() - p0
             n += hb["packed"].shape[0]
-    value = n / (time.perf_counter() - t0)
+    wall = time.perf_counter() - t0
+    r1 = resource.getrusage(resource.RUSAGE_SELF)
+    decode_s = METRICS.stage("decode").seconds - d0
+    cpu_s = (r1.ru_utime - r0.ru_utime) + (r1.ru_stime - r0.ru_stime)
+    value = n / wall
     bound = disk_mbps * 1e6 / bytes_per_example  # ex/s if purely IO-bound
+    # The raw-disk bound is unreachable when decode CPU alone exceeds the
+    # disk's per-example time budget (a 1-core host decoding at ~0.8us/ex
+    # cannot ingest a >2 GB/s stream at ~0.3us/ex). The corrected bound is
+    # the binding constraint: min(disk rate, this run's measured CPU work
+    # rate) — a multi-core host relaxes the CPU term toward the disk bound.
+    cpu_bound = n / cpu_s if cpu_s > 0 else None
+    eff_bound = min(bound, cpu_bound) if cpu_bound else bound
     return {
         "cold_value": round(value, 1),
         # serial no-hint read rate measured immediately before the pass
@@ -226,6 +258,16 @@ def _cold_io_throughput(data_dir, schema, hash_buckets, pack) -> dict:
         # the store sped up/slowed down between the two measurements)
         "cold_disk_bound_value": round(bound, 1),
         "cold_vs_disk_bound": round(value / bound, 3) if bound else None,
+        "cold_cpu_bound_value": round(cpu_bound, 1) if cpu_bound else None,
+        "cold_vs_bound": round(value / eff_bound, 3) if eff_bound else None,
+        "cold_stage_s": {
+            "wall": round(wall, 3),
+            "cpu": round(cpu_s, 3),
+            "decode_workers": round(decode_s, 3),
+            "consumer_wait": round(wait_s, 3),
+            "consumer_pack": round(pack_s, 3),
+        },
+        "cold_majflt": r1.ru_majflt - r0.ru_majflt,
         "cold_wire_bytes_per_example": round(bytes_per_example, 1),
         "cold_workers": workers,
         "cold_readahead_mb": readahead >> 20,
@@ -524,6 +566,45 @@ def main() -> None:
         n_cpus = os.cpu_count() or 1
     serial = n_cpus == 1
 
+    # Deliberate pack-slowdown injection for validating the attribution
+    # protocol (see PARITY.md): a busy-wait of this many ms rides EVERY call
+    # through _pack_one — so a genuine pack regression elevates BOTH the
+    # in-loop pack stage and the no-transfer pack_floor below, while shaper
+    # interference (a concurrent transfer burning the single core) elevates
+    # only the in-loop number. That asymmetry is what makes attempts[]
+    # self-explaining.
+    pack_spin_s = float(os.environ.get("TFR_BENCH_PACK_SPIN_MS", 0)) / 1e3
+
+    def _pack_one(cb):
+        hb = host_batch_from_columnar(
+            cb, ds.schema, hash_buckets=hash_buckets, pack=pack
+        )
+        m = pack_mixed(hb["packed"], 14, CAT_BITS)
+        if pack_spin_s:
+            spin_until = time.perf_counter() + pack_spin_s
+            while time.perf_counter() < spin_until:
+                pass
+        return m
+
+    def _pack_floor_ms(cb, iters: int = 5) -> float:
+        """Best-of-N of the full pack stage (host batch assembly + 20-bit
+        bit-pack) with NO transfer in flight: the attempt's clean-core
+        reference for its in-loop pack number."""
+        best = float("inf")
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            _pack_one(cb)
+            best = min(best, time.perf_counter() - t0)
+        return best * 1e3
+
+    # One decoded chunk reused by every attempt's pack floor (decoding it
+    # fresh would measure the decode thread, not the pack stage).
+    _floor_it = ds.batches()
+    try:
+        _floor_cb = next(iter(_floor_it))
+    finally:
+        _floor_it.close()
+
     def measure_attempt(attempt: int = 0) -> dict:
         """Link probe + measurement windows + sustained phase: one attempt."""
         # Raw-link probe: 8 transfers of one wire-batch-sized array, fresh
@@ -533,6 +614,9 @@ def main() -> None:
         # device sits behind a shaped tunnel whose bandwidth swings
         # 130MB/s..1.4GB/s independent of this pipeline (PARITY.md
         # "Device link").
+        # Clean-core pack floor FIRST (before the probe opens the link): the
+        # reference its in-loop pack number is judged against.
+        pack_floor_ms = _pack_floor_ms(_floor_cb)
         probe_rng = np.random.default_rng(123 + attempt)  # fresh bytes per attempt
         probe_arrs = [
             probe_rng.integers(0, 1 << 20, size=(BATCH_SIZE, 31), dtype=np.int32)
@@ -571,10 +655,7 @@ def main() -> None:
                 except StopIteration:
                     return
                 t1 = time.perf_counter()
-                hb = host_batch_from_columnar(
-                    cb, ds.schema, hash_buckets=hash_buckets, pack=pack
-                )
-                m = pack_mixed(hb["packed"], 14, CAT_BITS)
+                m = _pack_one(cb)
                 stage["decode_wait_s"] += t1 - t0
                 stage["pack_s"] += time.perf_counter() - t1
                 stage["batches"] += 1
@@ -610,6 +691,10 @@ def main() -> None:
         # windows reports the link-shaped sustained rate.
         windows = []
         sustained_value = None
+        import resource
+
+        r0 = resource.getrusage(resource.RUSAGE_SELF)
+        t_attempt0 = time.perf_counter()
         try:
             for _ in range(WARMUP_BATCHES):
                 consume_one()
@@ -638,12 +723,28 @@ def main() -> None:
             if prefetcher is not None:
                 prefetcher.close()
             it.close()
+        r1 = resource.getrusage(resource.RUSAGE_SELF)
+        attempt_wall = time.perf_counter() - t_attempt0
+        attempt_cpu = (r1.ru_utime - r0.ru_utime) + (r1.ru_stime - r0.ru_stime)
         out = {
             "value": round(statistics.median(windows), 1),
             "windows": [round(w, 1) for w in windows],
             "sustained_value": round(sustained_value, 1) if sustained_value else None,
             "link_probe_mbps": round(link_probe_mbps, 1),
             "ingest_duty_cycle": round(ingest_duty, 4),
+            # Attribution context (verdict r4 item 4): pack_floor_ms is the
+            # SAME pack code path timed with no transfer in flight, fresh
+            # each attempt — in-loop pack >> floor while the floor holds
+            # steady means a concurrent transfer was burning the core
+            # (shaper busy-wait), NOT a pack regression (which would raise
+            # the floor too; validate with TFR_BENCH_PACK_SPIN_MS).
+            # cpu_frac near 1.0 says the wall went to CPU work on this
+            # 1-core host; well under 1.0 says blocked on the link.
+            "pack_floor_ms": round(pack_floor_ms, 2),
+            "attempt_cpu_frac": round(attempt_cpu / attempt_wall, 3)
+            if attempt_wall > 0
+            else None,
+            "attempt_nivcsw": r1.ru_nivcsw - r0.ru_nivcsw,
         }
         if stage["batches"]:
             nb = stage["batches"]
@@ -735,8 +836,10 @@ def main() -> None:
         # device-free pipeline throughput (decode+hash+pack, no device)
         "host_side_value": round(host_side_value, 1),
     }
-    if len(attempts) > 1:
-        # full disclosure: every measurement attempt with its link state
+    if attempts:
+        # full disclosure: every measurement attempt with its link state and
+        # attribution context (pack_floor_ms / cpu_frac / nivcsw) — emitted
+        # even for a single attempt, which carries the same context
         out["attempts"] = attempts
     if cold_info is not None:
         # dropped-page-cache pass + raw-disk disclosure (TFR_BENCH_COLD=1)

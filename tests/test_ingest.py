@@ -327,6 +327,13 @@ class TestSequenceIngest:
             )
         with pytest.raises(ValueError, match="no castable data column"):
             batch_spec(ds.schema, 4, pad_to=pad_to, cast={"frame": ml_dtypes.bfloat16})
+        # casting a pack-group member would be silently skipped on the
+        # native pushed-down path — must refuse loudly instead
+        with pytest.raises(ValueError, match="pack group"):
+            host_batch_from_columnar(
+                cb, ds.schema, pad_to=pad_to,
+                cast={"id": np.float32}, pack={"g": ["id"]},
+            )
 
 
 def _heavy_step(scan_length):

@@ -214,6 +214,17 @@ def host_batch_from_columnar(
     hash_buckets = hash_buckets or {}
     cast = cast or {}
     _validate_cast(schema, cast)
+    if cast and pack:
+        # A pack group is ONE matrix with one dtype — a per-member cast
+        # would be silently skipped when the group was materialized by the
+        # native decoder, defeating _validate_cast's loud-failure contract.
+        for group, names in pack.items():
+            overlap = sorted(set(cast) & set(names))
+            if overlap:
+                raise ValueError(
+                    f"cast: columns {overlap} are members of pack group "
+                    f"{group!r}; casting packed members is not supported"
+                )
     out: Dict[str, np.ndarray] = {}
     # Groups already materialized by the native decoder (pack pushed down):
     # take their matrices directly and skip the member fields.
