@@ -179,3 +179,159 @@ def test_scheme_errors_cleanly(monkeypatch):
     # unknown protocol should raise a clear error, not silently read nothing
     with pytest.raises(Exception):
         tfio.read("noproto42://bucket/x", schema=SCHEMA)
+
+
+class TestRemotePrefetch:
+    """Block-pipelined remote readahead (VERDICT r4 item 3): N concurrent
+    range fetches hide per-block link latency; a serial read pays it."""
+
+    @staticmethod
+    def _latency_fs(base_fs, per_read_s):
+        """Wrap an FsspecFS so every read on every handle sleeps per_read_s
+        first — a simulated high-RTT link whose handles, like a real object
+        store's (and unlike fsspec memory://'s shared cursor), are
+        INDEPENDENT and safe to use from concurrent fetch threads: each
+        _SlowFile keeps its own position and serializes only the brief
+        seek+read on the shared inner file, with the latency sleep outside
+        the lock so concurrent range requests overlap like real GETs."""
+        import threading
+        import time as _time
+
+        io_lock = threading.Lock()
+
+        class _SlowFile:
+            def __init__(self, inner):
+                self._inner = inner
+                self._pos = 0
+                self._closed = False
+
+            def seek(self, pos, whence=0):
+                assert whence == 0
+                self._pos = pos
+                return pos
+
+            def tell(self):
+                return self._pos
+
+            def read(self, size=-1):
+                _time.sleep(per_read_s)  # the link RTT: outside the lock
+                with io_lock:
+                    self._inner.seek(self._pos)
+                    data = self._inner.read(size)
+                self._pos += len(data)
+                return data
+
+            def readinto(self, b):
+                data = self.read(len(b))
+                b[: len(data)] = data
+                return len(data)
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                self.close()
+
+            def close(self):
+                self._closed = True
+
+            @property
+            def closed(self):
+                return self._closed
+
+        class _SlowFS:
+            # independent handles: opt out of the memory:// serialization
+            # (fs._shares_read_handles stops at the first declared protocol)
+            protocol = "slowlink"
+
+            def __init__(self, fs):
+                self._fs = fs
+
+            def open(self, path, mode):
+                return _SlowFile(self._fs.open(path, mode))
+
+            def __getattr__(self, name):
+                return getattr(self._fs, name)
+
+        return _SlowFS(base_fs)
+
+    @pytest.mark.perf
+    def test_prefetch_saturates_simulated_link(self, mem_url, monkeypatch):
+        """With per-block latency L and depth D, a serial loop takes
+        ~nblocks*L while the pipeline takes ~nblocks*L/D — assert a real
+        win, and byte-exact equality with the serial read."""
+        import time as _time
+
+        nbytes = 24 << 20
+        payload = bytes(np.random.default_rng(0).integers(0, 256, nbytes, np.uint8))
+        path = mem_url + "/big.bin"
+        fs = tfs.filesystem_for(path)
+        with fs.open(path, "wb") as fh:
+            fh.write(payload)
+        monkeypatch.setenv("TFR_REMOTE_BLOCK_BYTES", str(2 << 20))
+        monkeypatch.setenv("TFR_REMOTE_PREFETCH_DEPTH", "4")
+        slow = self._latency_fs(fs, per_read_s=0.04)
+
+        def drain(fh):
+            # drain at the SAME granularity the link charges latency per
+            # (one RTT per read call): 12 RTTs serial vs ceil(12/4) waves
+            # pipelined — a 4x gap with real margin for per-block overhead
+            out = []
+            while True:
+                chunk = fh.read(2 << 20)
+                if not chunk:
+                    return b"".join(out)
+                out.append(chunk)
+
+        t0 = _time.perf_counter()
+        with slow.open(path, "rb") as fh:
+            serial = drain(fh)
+        t_serial = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        with tfs.open_for_read(slow, path) as fh:
+            assert isinstance(fh, tfs.PrefetchReader)
+            pipelined = drain(fh)
+        t_pipe = _time.perf_counter() - t0
+        assert pipelined == serial == payload
+        # depth 4 should give ~4x; 1.8x is the regression bar (pool silently
+        # degrading to serial)
+        assert t_pipe < t_serial / 1.8, (t_serial, t_pipe)
+
+    def test_dataset_read_uses_prefetch_and_matches(self, mem_url, monkeypatch):
+        """End-to-end: a remote dataset big enough to engage the prefetcher
+        decodes identically with pipelining on and off — and the pipelined
+        leg PROVABLY routes through PrefetchReader (a block size above
+        size/2 would silently fall back to the plain handle and compare two
+        identical code paths)."""
+        out = mem_url + "/ds"
+        schema = StructType([StructField("x", LongType()), StructField("s", StringType())])
+        rows = [[i, "v" * 64] for i in range(5000)]
+        tfio.write(rows, schema, out, mode="overwrite")
+        # ~0.6 MB shard: 128 KiB blocks satisfy open_for_read's
+        # size >= 2*block engagement bar with blocks to spare
+        monkeypatch.setenv("TFR_REMOTE_BLOCK_BYTES", str(128 << 10))
+        built = []
+        real_init = tfs.PrefetchReader.__init__
+        monkeypatch.setattr(
+            tfs.PrefetchReader,
+            "__init__",
+            lambda self, *a, **k: (built.append(1), real_init(self, *a, **k))[1],
+        )
+
+        def read_ids():
+            ds = TFRecordDataset(out, batch_size=512, schema=schema,
+                                 drop_remainder=False, use_mmap=False)
+            got = []
+            with ds.batches() as it:
+                for cb in it:
+                    got.extend(cb["x"].values.tolist())
+            return got
+
+        monkeypatch.setenv("TFR_REMOTE_PREFETCH_DEPTH", "4")
+        with_prefetch = read_ids()
+        assert built, "prefetcher never engaged — block bar not met?"
+        monkeypatch.setenv("TFR_REMOTE_PREFETCH_DEPTH", "0")
+        n_engaged = len(built)
+        without = read_ids()
+        assert len(built) == n_engaged, "depth=0 must disable the prefetcher"
+        assert with_prefetch == without == list(range(5000))

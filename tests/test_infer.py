@@ -1,5 +1,7 @@
 """Tier-1 tests for schema inference, mirroring InferSchemaSuite.scala."""
 
+import os
+
 import pytest
 
 from tpu_tfrecord import infer, proto
@@ -13,6 +15,8 @@ from tpu_tfrecord.schema import (
     LongType,
     NullType,
     StringType,
+    StructField,
+    StructType,
 )
 
 long_feature = Feature.int64_list([2**31 + 10])
@@ -196,3 +200,240 @@ class TestMergeAlgebra:
         for _ in range(50):
             a = self.random_map(rng)
             assert merge_type_maps(a, a) == a
+
+
+class TestNativeInferOracle:
+    """The native wire-walk inference seqOp (tfr_infer_batch) must match the
+    Python oracle exactly — clean maps AND error class/record — over
+    adversarial wire layouts: duplicate map keys (last-wins masking a
+    kind-unset error), repeated kind fields (merge vs replace), packed and
+    unpacked encodings, split features segments, empty lists/FeatureLists."""
+
+    @staticmethod
+    def _varint(v: int) -> bytes:
+        out = b""
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                out += bytes([b | 0x80])
+            else:
+                return out + bytes([b])
+
+    @classmethod
+    def _tag(cls, f: int, w: int) -> bytes:
+        return cls._varint((f << 3) | w)
+
+    @classmethod
+    def _ld(cls, f: int, payload: bytes) -> bytes:
+        return cls._tag(f, 2) + cls._varint(len(payload)) + payload
+
+    def _rand_feature(self, rng) -> bytes:
+        import numpy as np
+
+        if rng.random() < 0.08:
+            return b""  # kind unset -> SchemaInferenceError unless masked
+        segs = b""
+        for _ in range(rng.choice([1, 1, 1, 2])):
+            kind = rng.choice([1, 2, 3])
+            n = rng.choice([0, 0, 1, 1, 1, 2, 5])
+            if kind == 1:
+                inner = b"".join(
+                    self._ld(1, bytes(rng.randrange(256) for _ in range(rng.randrange(4))))
+                    for _ in range(n)
+                )
+            elif kind == 2:
+                if rng.random() < 0.5:
+                    inner = self._ld(1, np.arange(n, dtype="<f4").tobytes())
+                else:
+                    inner = b"".join(
+                        self._tag(1, 5) + np.float32(i).tobytes() for i in range(n)
+                    )
+            else:
+                if rng.random() < 0.5:
+                    inner = self._ld(
+                        1, b"".join(self._varint(rng.randrange(1 << 40)) for _ in range(n))
+                    )
+                else:
+                    inner = b"".join(
+                        self._tag(1, 0) + self._varint(rng.randrange(1 << 40))
+                        for _ in range(n)
+                    )
+            segs += self._ld(kind, inner)
+        return segs
+
+    def _rand_example(self, rng) -> bytes:
+        names = ["a", "b", "c", "dup", "dup", "x" * 30]
+        rng.shuffle(names)
+        entries = b""
+        for nm in names[: rng.randrange(1, 6)]:
+            entry = self._ld(1, nm.encode())
+            if rng.random() < 0.95:
+                entry += self._ld(2, self._rand_feature(rng))
+            entries += self._ld(1, entry)
+        out = self._ld(1, entries)
+        if rng.random() < 0.3:
+            # second features segment: dict.update merge semantics
+            out += self._ld(
+                1, self._ld(1, self._ld(1, b"late") + self._ld(2, self._rand_feature(rng)))
+            )
+        return out
+
+    def _rand_seq_example(self, rng) -> bytes:
+        out = self._rand_example(rng)  # context shares the map layout
+        fl = b""
+        for nm in ["s1", "s2", "dupfl", "dupfl"][: rng.randrange(0, 4)]:
+            inner = b"".join(
+                self._ld(1, self._rand_feature(rng)) for _ in range(rng.randrange(0, 4))
+            )
+            fl += self._ld(1, self._ld(1, nm.encode()) + self._ld(2, inner))
+        return out + (self._ld(2, fl) if fl else b"")
+
+    def _run_case(self, records, record_type):
+        import numpy as np
+
+        from tpu_tfrecord import _native
+        from tpu_tfrecord.infer import infer_from_records, type_map_from_precedences
+        from tpu_tfrecord.proto import ProtoDecodeError
+
+        try:
+            oracle, oracle_exc = infer_from_records(iter(records), record_type), None
+        except (SchemaInferenceError, ProtoDecodeError) as e:
+            oracle, oracle_exc = None, type(e).__name__
+        buf = b"".join(records)
+        offsets = np.cumsum([0] + [len(r) for r in records[:-1]]).astype(np.uint64)
+        lengths = np.array([len(r) for r in records], np.uint64)
+        try:
+            with _native.InferScanner(record_type) as sc:
+                k = len(records) // 2  # two updates: exercise accumulation
+                sc.update(buf, offsets[:k], lengths[:k])
+                sc.update(buf, offsets[k:], lengths[k:])
+                native, native_exc = type_map_from_precedences(sc.result()), None
+        except (SchemaInferenceError, ProtoDecodeError) as e:
+            native, native_exc = None, type(e).__name__
+        assert oracle_exc == native_exc, (oracle_exc, native_exc)
+        assert oracle == native
+
+    def test_differential_example(self):
+        import random
+
+        from tpu_tfrecord import _native
+
+        if not _native.available():
+            pytest.skip("native lib unavailable")
+        rng = random.Random(7)
+        for _ in range(400):
+            self._run_case(
+                [self._rand_example(rng) for _ in range(rng.randrange(1, 8))],
+                RecordType.EXAMPLE,
+            )
+
+    def test_differential_sequence_example(self):
+        import random
+
+        from tpu_tfrecord import _native
+
+        if not _native.available():
+            pytest.skip("native lib unavailable")
+        rng = random.Random(8)
+        for _ in range(400):
+            self._run_case(
+                [self._rand_seq_example(rng) for _ in range(rng.randrange(1, 8))],
+                RecordType.SEQUENCE_EXAMPLE,
+            )
+
+    def test_limit_skips_corruption_past_sample(self, tmp_path):
+        """With inferSampleLimit=N, corruption AFTER the N sampled records
+        must not fail inference — the limit is pushed into the span scan so
+        trailing bytes are never framed or CRC-checked, matching the lazy
+        per-record oracle (code-review r5 finding)."""
+        import numpy as np
+
+        import tpu_tfrecord.io as tfio
+        from tpu_tfrecord import _native, wire
+
+        if not _native.available():
+            pytest.skip("native lib unavailable")
+        out = tmp_path / "corrupt"
+        schema = StructType([StructField("a", LongType())])
+        tfio.write([[i] for i in range(50)], schema, str(out), mode="overwrite")
+        shard = next(p for p in os.listdir(out) if p.startswith("part-"))
+        path = out / shard
+        data = bytearray(path.read_bytes())
+        data[-6] ^= 0xFF  # corrupt the last record's payload (CRC mismatch)
+        path.write_bytes(bytes(data))
+        # full inference sees the corruption
+        with pytest.raises(wire.TFRecordCorruptionError):
+            tfio.reader(str(out)).schema()
+        # sampled inference stops before it
+        r = tfio.reader(str(out), inferSampleLimit=10)
+        assert [f.name for f in r.schema()] == ["a"]
+        np.testing.assert_array_equal(
+            [row[0] for row in tfio.read(str(out), schema=schema, limit=10).rows],
+            list(range(10)),
+        )
+
+    def test_span_stream_limit_contract_pure_python(self, tmp_path, monkeypatch):
+        """scan_spans_stream's pure-Python leg honors max_records the same
+        way the native leg does: bytes past the sampled records are never
+        framed or CRC-checked, even within an already-read slab
+        (code-review r5 finding — the fallback used to frame the whole slab
+        first and so raised on corruption past the limit)."""
+        from tpu_tfrecord import _native, wire
+        from tpu_tfrecord.io.reader import scan_spans_stream
+
+        path = tmp_path / "x.tfrecord"
+        wire.write_records(str(path), [b"payload-%02d" % i for i in range(20)])
+        data = bytearray(path.read_bytes())
+        data[-3] ^= 0xFF  # corrupt the LAST record's payload (CRC mismatch)
+        path.write_bytes(bytes(data))
+
+        def spans(max_records):
+            out = []
+            for buf, offs, lens in scan_spans_stream(
+                str(path), True, max_records=max_records
+            ):
+                out.extend(
+                    bytes(buf[int(o) : int(o) + int(l)])
+                    for o, l in zip(offs, lens)
+                )
+            return out
+
+        for native_on in (True, False):
+            if native_on and not _native.available():
+                continue
+            monkeypatch.setattr(_native, "available", lambda v=native_on: v)
+            got = spans(max_records=5)
+            assert got == [b"payload-%02d" % i for i in range(5)], native_on
+            with pytest.raises(wire.TFRecordCorruptionError):
+                spans(max_records=None)
+
+    def test_reader_native_path_matches_oracle_with_limit(self, tmp_path):
+        """DatasetReader._shard_type_map (native) == infer_from_records
+        (oracle) including infer_sample_limit truncation."""
+        import numpy as np
+
+        import tpu_tfrecord.io as tfio
+        from tpu_tfrecord import _native, wire
+        from tpu_tfrecord.infer import infer_from_records
+
+        if not _native.available():
+            pytest.skip("native lib unavailable")
+        out = str(tmp_path / "ds")
+        schema = StructType(
+            [StructField("a", LongType()), StructField("v", ArrayType(FloatType()))]
+        )
+        rng = np.random.default_rng(3)
+        rows = [
+            [int(rng.integers(0, 100)), [float(x) for x in rng.normal(size=rng.integers(1, 4))]]
+            for _ in range(200)
+        ]
+        tfio.write(rows, schema, out, mode="overwrite")
+        for limit in (None, 1, 7, 200, 10_000):
+            r = tfio.reader(out, inferSampleLimit=limit) if limit else tfio.reader(out)
+            sh = r.shards[0]
+            native = r._shard_type_map(sh)
+            oracle = infer_from_records(
+                wire.read_records(sh.path), RecordType.EXAMPLE, limit=limit
+            )
+            assert native == oracle, limit

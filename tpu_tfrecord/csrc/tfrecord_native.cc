@@ -21,6 +21,7 @@
 #include <cstdint>
 #include <cstring>
 #include <cstdio>
+#include <deque>
 #include <mutex>
 #include <string>
 #include <string_view>
@@ -1134,7 +1135,12 @@ int64_t tfr_scan(const uint8_t* buf, uint64_t len, int32_t verify,
 // extends past the end of the buffer is NOT an error — scanning stops and
 // *consumed is set to the byte offset of that record's frame start, so the
 // caller can carry the tail into the next slab. CRC failures on complete
-// records still error.
+// records still error. Reaching ``cap`` records is a CLEAN stop (not an
+// error): bytes past the cap are neither framed nor CRC-checked, which is
+// what lets record-limited consumers (schema-inference sampling) match the
+// lazy Python reader on shards whose corruption lies beyond the limit.
+// (tfr_scan's full-buffer contract still reports a short scan as -2 via
+// its consumed != len check.)
 int64_t tfr_scan_partial(const uint8_t* buf, uint64_t len, int32_t verify,
                          uint64_t* offsets, uint64_t* lengths, int64_t cap,
                          uint64_t* consumed) {
@@ -1143,6 +1149,7 @@ int64_t tfr_scan_partial(const uint8_t* buf, uint64_t len, int32_t verify,
   int64_t n = 0;
   *consumed = 0;
   while (pos < len) {
+    if (n >= cap) break;  // clean stop: caller resumes from *consumed
     if (pos + 12 > len) break;  // incomplete header -> tail
     uint64_t rec_len;
     std::memcpy(&rec_len, buf + pos, 8);
@@ -1156,7 +1163,6 @@ int64_t tfr_scan_partial(const uint8_t* buf, uint64_t len, int32_t verify,
       std::memcpy(&data_crc, buf + start + rec_len, 4);
       if (masked_crc(buf + start, rec_len) != data_crc) return -3;
     }
-    if (n >= cap) return -4;
     offsets[n] = start;
     lengths[n] = rec_len;
     n++;
@@ -2285,5 +2291,402 @@ int64_t tfr_pad_ragged2(const void* values, int32_t in_kind,
   }
   return 0;
 }
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Native schema-inference seqOp
+// ---------------------------------------------------------------------------
+// The reference runs inference as an executor-parallel RDD aggregate
+// (TensorFlowInferSchema.scala:40-43). The Python oracle (infer.py) is a
+// per-record parse + precedence-lattice fold — pure Python, GIL-bound, so a
+// thread pool cannot scale it within a host. This seqOp walks the proto
+// wire directly (no value materialization) and aggregates, per feature
+// name, the MAX precedence contribution — the lattice is a precedence max
+// with null as identity (infer.py:77-115), so the fold is associative and
+// a per-shard (name -> max prec) map is a complete partial result. GIL is
+// released for the whole batch call; shards scan concurrently for real.
+//
+// Precedence encoding mirrors infer.py exactly: 0 null, 1 Long, 2 Float,
+// 3 String, 4-6 Array(base), 7-9 Array(Array(base)). -1 marks a kind-unset
+// feature (infer.py raises SchemaInferenceError) — the error is DEFERRED to
+// fold time so a last-wins duplicate key can mask it, matching the oracle,
+// which parses each record's maps fully (dict overwrite) before inferring.
+
+namespace {
+
+constexpr int8_t kInferErrorPrec = -1;
+
+struct InferCol {
+  std::string name;
+  int8_t max_prec = 0;
+  int8_t pending = 0;
+  int64_t epoch = -1;
+  bool has_pending = false;
+};
+
+struct InferState {
+  // deque: no element moves on growth (FieldMap owns its key strings, so
+  // this is about avoiding vector reallocation copies, not key lifetime)
+  std::deque<InferCol> cols;
+  FieldMap index;
+  // Columns contributed-to since the last finalize: the per-record fold
+  // touches only these, keeping the seqOp O(features per record), not
+  // O(distinct features) per record (wide-sparse data would otherwise
+  // erode the native speedup). May hold duplicates; fold is idempotent.
+  std::vector<int32_t> touched;
+  int64_t records = 0;
+  std::string err;
+
+  int lookup_or_add(std::string_view name) {
+    auto it = index.find(name);
+    if (it != index.end()) return it->second;
+    cols.emplace_back();
+    cols.back().name.assign(name.data(), name.size());
+    int idx = (int)cols.size() - 1;
+    index.emplace(cols.back().name, idx);
+    return idx;
+  }
+
+  bool fold(InferCol& c) {
+    if (!c.has_pending) return true;
+    c.has_pending = false;
+    if (c.pending == kInferErrorPrec) {
+      err = "unsupported feature kind (oneof unset)";
+      return false;
+    }
+    if (c.pending > c.max_prec) c.max_prec = c.pending;
+    return true;
+  }
+
+  // Record one (name -> contribution) observation. epoch_tag identifies
+  // (record, which map): a repeat within the same tag is a duplicate map
+  // key -> last-wins overwrite; a new tag folds the previous pending.
+  bool contribute(std::string_view name, int8_t prec, int64_t epoch_tag) {
+    int idx = lookup_or_add(name);
+    InferCol& c = cols[idx];
+    if (c.epoch != epoch_tag) {
+      if (!fold(c)) return false;
+      c.epoch = epoch_tag;
+      touched.push_back(idx);
+    }
+    c.pending = prec;
+    c.has_pending = true;
+    return true;
+  }
+
+  bool finalize_pending() {
+    for (int32_t idx : touched)
+      if (!fold(cols[idx])) return false;
+    touched.clear();
+    return true;
+  }
+};
+
+// Walk one Feature submessage -> contribution prec (0 empty, 1..6, or
+// kInferErrorPrec for kind-unset). Mirrors proto.py _parse_feature's merge
+// semantics: a repeated occurrence of the SAME list kind concatenates
+// (counts add), a different kind REPLACES (count resets); fields 1..3 with
+// a non-LEN wire type are ignored. Counts never materialize values:
+// int64 packed counts varint terminators, floats count plen/4.
+bool infer_feature_walk(const uint8_t* p, const uint8_t* end, int8_t* out,
+                        std::string& err) {
+  int kind = 0;
+  uint64_t count = 0;
+  Cursor c{p, end};
+  while (c.p < c.end) {
+    uint64_t tag;
+    if (!read_varint(c, &tag)) { err = "truncated feature tag"; return false; }
+    uint32_t fnum = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+    if (wt != 2 || fnum < 1 || fnum > 3) {
+      if (!skip_field(c, wt)) { err = "bad feature field"; return false; }
+      continue;
+    }
+    uint64_t len;
+    if (!read_varint(c, &len) || (uint64_t)(c.end - c.p) < len) {
+      err = "truncated list"; return false;
+    }
+    Cursor lc{c.p, c.p + len};
+    c.p += len;
+    if ((int)fnum != kind) { kind = (int)fnum; count = 0; }
+    while (lc.p < lc.end) {
+      uint64_t ltag;
+      if (!read_varint(lc, &ltag)) { err = "truncated list tag"; return false; }
+      uint32_t lnum = (uint32_t)(ltag >> 3), lwt = (uint32_t)(ltag & 7);
+      if (lnum != 1) {
+        if (!skip_field(lc, lwt)) { err = "bad list field"; return false; }
+        continue;
+      }
+      if (fnum == 1) {  // BytesList
+        if (lwt == 2) {
+          uint64_t bl;
+          if (!read_varint(lc, &bl) || (uint64_t)(lc.end - lc.p) < bl) {
+            err = "truncated bytes"; return false;
+          }
+          lc.p += bl;
+          count++;
+        } else if (!skip_field(lc, lwt)) { err = "bad bytes enc"; return false; }
+      } else if (fnum == 2) {  // FloatList
+        if (lwt == 2) {
+          uint64_t pl;
+          if (!read_varint(lc, &pl) || (uint64_t)(lc.end - lc.p) < pl) {
+            err = "truncated packed"; return false;
+          }
+          if (pl % 4) { err = "packed float payload not 4-aligned"; return false; }
+          lc.p += pl;
+          count += pl / 4;
+        } else if (lwt == 5) {
+          if (lc.end - lc.p < 4) { err = "truncated float"; return false; }
+          lc.p += 4;
+          count++;
+        } else if (!skip_field(lc, lwt)) { err = "bad float enc"; return false; }
+      } else {  // Int64List
+        if (lwt == 2) {
+          uint64_t pl;
+          if (!read_varint(lc, &pl) || (uint64_t)(lc.end - lc.p) < pl) {
+            err = "truncated packed"; return false;
+          }
+          // count terminators, mirroring the oracle's validation exactly:
+          // 10 continuation bytes -> "varint too long" (proto.py shift>63),
+          // payload ending mid-varint -> truncated (proto.py boundary check)
+          uint32_t run = 0;
+          for (const uint8_t* q = lc.p; q < lc.p + pl; q++) {
+            if (*q & 0x80) {
+              if (++run == 10) { err = "varint too long"; return false; }
+            } else {
+              run = 0;
+              count++;
+            }
+          }
+          if (run) {
+            err = "truncated varint in packed int64 list";
+            return false;
+          }
+          lc.p += pl;
+        } else if (lwt == 0) {
+          uint64_t v;
+          if (!read_varint(lc, &v)) { err = "truncated varint"; return false; }
+          count++;
+        } else if (!skip_field(lc, lwt)) { err = "bad int enc"; return false; }
+      }
+    }
+  }
+  if (kind == 0) { *out = kInferErrorPrec; return true; }
+  const int8_t base = kind == 1 ? 3 : kind == 2 ? 2 : 1;  // String/Float/Long
+  *out = count == 0 ? (int8_t)0 : count == 1 ? base : (int8_t)(base + 3);
+  return true;
+}
+
+// One Features map region (Example.features / SequenceExample.context).
+// Entry semantics mirror proto.py _parse_features_map: nameless entries are
+// skipped; the LAST value field within an entry wins; an entry with no
+// value field is an empty Feature (kind unset -> deferred error).
+bool infer_features_map(InferState& st, const uint8_t* p, const uint8_t* end,
+                        int64_t epoch_tag, std::string& err) {
+  Cursor c{p, end};
+  while (c.p < c.end) {
+    uint64_t tag;
+    if (!read_varint(c, &tag)) { err = "truncated features tag"; return false; }
+    if ((tag >> 3) != 1 || (tag & 7) != 2) {
+      if (!skip_field(c, (uint32_t)(tag & 7))) { err = "bad features field"; return false; }
+      continue;
+    }
+    uint64_t elen;
+    if (!read_varint(c, &elen) || (uint64_t)(c.end - c.p) < elen) {
+      err = "truncated map entry"; return false;
+    }
+    Cursor ec{c.p, c.p + elen};
+    c.p += elen;
+    std::string_view name;
+    bool has_name = false;
+    const uint8_t* fs = nullptr;
+    const uint8_t* fe = nullptr;
+    bool has_feat = false;
+    while (ec.p < ec.end) {
+      uint64_t etag;
+      if (!read_varint(ec, &etag)) { err = "truncated entry tag"; return false; }
+      uint32_t enum_ = (uint32_t)(etag >> 3), ewt = (uint32_t)(etag & 7);
+      if (enum_ == 1 && ewt == 2) {
+        uint64_t klen;
+        if (!read_varint(ec, &klen) || (uint64_t)(ec.end - ec.p) < klen) {
+          err = "truncated key"; return false;
+        }
+        name = std::string_view((const char*)ec.p, klen);
+        has_name = true;
+        ec.p += klen;
+      } else if (enum_ == 2 && ewt == 2) {
+        uint64_t flen;
+        if (!read_varint(ec, &flen) || (uint64_t)(ec.end - ec.p) < flen) {
+          err = "truncated value"; return false;
+        }
+        fs = ec.p;
+        fe = ec.p + flen;
+        has_feat = true;
+        ec.p += flen;
+      } else if (!skip_field(ec, ewt)) { err = "bad entry field"; return false; }
+    }
+    if (!has_name) continue;
+    int8_t prec = kInferErrorPrec;
+    if (has_feat && !infer_feature_walk(fs, fe, &prec, err)) return false;
+    if (!st.contribute(name, prec, epoch_tag)) return false;
+  }
+  return true;
+}
+
+// One FeatureLists map region: per entry, fold the inner features' precs
+// (max), then wrap to the 2-level array band: base m in 1..3 -> m+6,
+// array m in 4..6 -> m+3 (matching infer_sequence_example_row_type's
+// ArrayType wrapping, infer.py:131-151); an unset-kind inner feature makes
+// the whole entry's contribution the deferred error.
+bool infer_feature_lists(InferState& st, const uint8_t* p, const uint8_t* end,
+                         int64_t epoch_tag, std::string& err) {
+  Cursor c{p, end};
+  while (c.p < c.end) {
+    uint64_t tag;
+    if (!read_varint(c, &tag)) { err = "truncated featurelists tag"; return false; }
+    if ((tag >> 3) != 1 || (tag & 7) != 2) {
+      if (!skip_field(c, (uint32_t)(tag & 7))) { err = "bad featurelists field"; return false; }
+      continue;
+    }
+    uint64_t elen;
+    if (!read_varint(c, &elen) || (uint64_t)(c.end - c.p) < elen) {
+      err = "truncated fl entry"; return false;
+    }
+    Cursor ec{c.p, c.p + elen};
+    c.p += elen;
+    std::string_view name;
+    bool has_name = false;
+    const uint8_t* ls = nullptr;
+    const uint8_t* le = nullptr;
+    while (ec.p < ec.end) {
+      uint64_t etag;
+      if (!read_varint(ec, &etag)) { err = "truncated fl entry tag"; return false; }
+      uint32_t enum_ = (uint32_t)(etag >> 3), ewt = (uint32_t)(etag & 7);
+      if (enum_ == 1 && ewt == 2) {
+        uint64_t klen;
+        if (!read_varint(ec, &klen) || (uint64_t)(ec.end - ec.p) < klen) {
+          err = "truncated fl key"; return false;
+        }
+        name = std::string_view((const char*)ec.p, klen);
+        has_name = true;
+        ec.p += klen;
+      } else if (enum_ == 2 && ewt == 2) {
+        uint64_t flen;
+        if (!read_varint(ec, &flen) || (uint64_t)(ec.end - ec.p) < flen) {
+          err = "truncated featurelist"; return false;
+        }
+        ls = ec.p;  // last value field wins (proto.py reassigns flist)
+        le = ec.p + flen;
+        ec.p += flen;
+      } else if (!skip_field(ec, ewt)) { err = "bad fl entry field"; return false; }
+    }
+    if (!has_name) continue;
+    int8_t m = 0;
+    bool entry_err = false;
+    Cursor lc{ls ? ls : end, le ? le : end};
+    while (lc.p < lc.end) {
+      uint64_t ltag;
+      if (!read_varint(lc, &ltag)) { err = "truncated fl tag"; return false; }
+      if ((ltag >> 3) != 1 || (ltag & 7) != 2) {
+        if (!skip_field(lc, (uint32_t)(ltag & 7))) { err = "bad fl field"; return false; }
+        continue;
+      }
+      uint64_t flen;
+      if (!read_varint(lc, &flen) || (uint64_t)(lc.end - lc.p) < flen) {
+        err = "truncated inner feature"; return false;
+      }
+      int8_t prec;
+      if (!infer_feature_walk(lc.p, lc.p + flen, &prec, err)) return false;
+      lc.p += flen;
+      if (prec == kInferErrorPrec) entry_err = true;
+      else if (prec > m) m = prec;
+    }
+    int8_t contribution;
+    if (entry_err) contribution = kInferErrorPrec;
+    else if (m == 0) contribution = 0;
+    else if (m <= 3) contribution = (int8_t)(m + 6);
+    else contribution = (int8_t)(m + 3);
+    if (!st.contribute(name, contribution, epoch_tag)) return false;
+  }
+  return true;
+}
+
+// One record: Example { features = 1 } or SequenceExample { context = 1,
+// feature_lists = 2 }. Distinct epoch tags for the two maps: duplicate keys
+// WITHIN a map are last-wins, the same name ACROSS maps folds.
+bool infer_one_record(InferState& st, const uint8_t* rp, uint64_t rlen,
+                      int32_t record_format, std::string& err) {
+  const int64_t r = st.records;
+  Cursor c{rp, rp + rlen};
+  while (c.p < c.end) {
+    uint64_t tag;
+    if (!read_varint(c, &tag)) { err = "truncated record tag"; return false; }
+    uint32_t fnum = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+    if (wt == 2 && ((record_format == 0 && fnum == 1) ||
+                    (record_format == 1 && (fnum == 1 || fnum == 2)))) {
+      uint64_t mlen;
+      if (!read_varint(c, &mlen) || (uint64_t)(c.end - c.p) < mlen) {
+        err = "truncated message"; return false;
+      }
+      const uint8_t* ms = c.p;
+      const uint8_t* me = c.p + mlen;
+      c.p += mlen;
+      bool ok = (record_format == 1 && fnum == 2)
+                    ? infer_feature_lists(st, ms, me, r * 2 + 1, err)
+                    : infer_features_map(st, ms, me, r * 2, err);
+      if (!ok) return false;
+    } else if (!skip_field(c, wt)) {
+      err = "bad record field";
+      return false;
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Accumulating inference over a batch of record spans. ``prev`` continues a
+// prior accumulation (slab streaming); pass nullptr to start one. Returns
+// the handle, or nullptr with errbuf filled (an existing ``prev`` is left
+// owned by the caller — free it with tfr_infer_free).
+void* tfr_infer_batch(const uint8_t* buf, const uint64_t* offsets,
+                      const uint64_t* lengths, int64_t n,
+                      int32_t record_format, void* prev, char* errbuf,
+                      int64_t errbuf_len) {
+  InferState* st = prev ? static_cast<InferState*>(prev) : new InferState();
+  for (int64_t i = 0; i < n; i++) {
+    // Fold at each record boundary (duplicate masking is within-record, so
+    // this is safe): a deferred kind-unset error surfaces at the SAME
+    // record index where the Python oracle raises, and entries stay
+    // readable after every batch.
+    if (!infer_one_record(*st, buf + offsets[i], lengths[i], record_format,
+                          st->err) ||
+        !st->finalize_pending()) {
+      std::snprintf(errbuf, errbuf_len, "record %lld: %s",
+                    (long long)st->records, st->err.c_str());
+      if (!prev) delete st;
+      return nullptr;
+    }
+    st->records++;
+  }
+  return st;
+}
+
+int64_t tfr_infer_size(void* h) {
+  return (int64_t) static_cast<InferState*>(h)->cols.size();
+}
+
+// Entry i: writes the name pointer/length, returns its max precedence.
+int64_t tfr_infer_entry(void* h, int64_t i, const char** name,
+                        int64_t* name_len) {
+  InferCol& c = static_cast<InferState*>(h)->cols[(size_t)i];
+  *name = c.name.data();
+  *name_len = (int64_t)c.name.size();
+  return c.max_prec;
+}
+
+void tfr_infer_free(void* h) { delete static_cast<InferState*>(h); }
 
 }  // extern "C"

@@ -151,6 +151,30 @@ def infer_sequence_example_row_type(acc: TypeMap, se: SequenceExample) -> TypeMa
     return acc
 
 
+# Precedence -> type, the inverse of _precedence (index == precedence).
+# Shared with the native inference seqOp (tfr_infer_batch), whose per-shard
+# output is a (name -> max precedence) map in exactly this encoding.
+_PREC_TYPES = [
+    None,
+    _LONG,
+    _FLOAT,
+    _STRING,
+    ArrayType(_LONG),
+    ArrayType(_FLOAT),
+    ArrayType(_STRING),
+    ArrayType(ArrayType(_LONG)),
+    ArrayType(ArrayType(_FLOAT)),
+    ArrayType(ArrayType(_STRING)),
+]
+
+
+def type_map_from_precedences(precs: Mapping[str, int]) -> TypeMap:
+    """Native seqOp partial (name -> max precedence 0..9) -> TypeMap.
+    Valid because the lattice merge IS precedence max with null identity
+    (find_tightest_common_type), so the max commutes with per-record folds."""
+    return {name: _PREC_TYPES[p] for name, p in precs.items()}
+
+
 def merge_type_maps(first: TypeMap, second: TypeMap) -> TypeMap:
     """The combOp: key union + tightest common type. Like the reference's
     ``.get`` on the Option (TensorFlowInferSchema.scala:124), merging two

@@ -271,68 +271,34 @@ class TFRecordDataset:
                 f"({self.max_record_bytes}) in {path} — corrupt length field?"
             )
 
-    def _read_slab(self, fh, tail: bytes, path: str) -> Optional[bytes]:
-        """Read the next slab, honoring the bounded tail-carry contract:
-        once a partial frame header is visible, the declared record length
-        caps how much more is read (one read, not repeated doubling), and a
-        declared length above ``max_record_bytes`` raises immediately — a
-        corrupt length field (possible with verify_crc=False) can never
-        buffer the rest of a huge shard before erroring. Returns
-        tail + fresh bytes, or None at clean EOF; raises on a truncated
-        trailing frame."""
-        want = self.slab_bytes
-        if len(tail) >= 8:
-            declared = int.from_bytes(tail[:8], "little")
-            self._check_declared_length(declared, path)
-            want = max(want, 16 + declared - len(tail))
-        data = fh.read(want)
-        if not data:
-            if tail:
-                raise self._truncated_error(path)
-            return None
-        return tail + data if tail else data
-
     def _shard_slabs(self, shard) -> Iterator[tuple]:
         """Stream one shard as (buf, offsets, lengths) slabs of complete
         frames — shards larger than memory never materialize whole (the tail
         of each read carries into the next slab). Compressed shards stream
-        through the codec the same way (bounded-carry contract in
-        ``_read_slab``)."""
+        through the codec the same way. The framing loop itself (bounded
+        tail-carry, declared-length guard) has ONE owner:
+        io.reader.scan_spans_stream; this wires in the dataset's slab size,
+        record-size cap, and sliding readahead window."""
         from tpu_tfrecord import fs as _fs
+        from tpu_tfrecord.io.reader import scan_spans_stream
 
-        codec = wire.codec_from_path(shard.path)
-        verify = self.options.verify_crc
-        with wire.open_compressed(shard.path, "rb", codec) as fh:
-            hint = _noop_hint
-            if not _fs.has_scheme(shard.path):
-                try:
-                    hint = _make_readahead(
-                        fh, os.path.getsize(shard.path), self.readahead_bytes
-                    )
-                except OSError:
-                    pass
-            carry = b""
-            while True:
-                try:
-                    hint(fh.tell())
-                except (AttributeError, OSError, ValueError):
-                    hint = _noop_hint
-                buf = self._read_slab(fh, carry, shard.path)
-                if buf is None:
-                    return
-                if _native.available():
-                    offsets, lengths, consumed = _native.scan_partial(buf, verify)
-                else:
-                    spans, consumed = wire.scan_buffer_partial(buf, verify)
-                    offsets = np.array([s for s, _ in spans], dtype=np.uint64)
-                    lengths = np.array([l for _, l in spans], dtype=np.uint64)
-                if len(offsets) == 0:
-                    # not even one complete record yet: keep accumulating
-                    # (bounded by the declared-length check above)
-                    carry = buf
-                    continue
-                carry = buf[consumed:]
-                yield buf, offsets, lengths
+        def make_hint(fh):
+            if _fs.has_scheme(shard.path):
+                return None
+            try:
+                return _make_readahead(
+                    fh, os.path.getsize(shard.path), self.readahead_bytes
+                )
+            except OSError:
+                return None
+
+        yield from scan_spans_stream(
+            shard.path,
+            self.options.verify_crc,
+            slab_bytes=self.slab_bytes,
+            max_record_bytes=self.max_record_bytes,
+            make_hint=make_hint,
+        )
 
     def epoch_order(self, epoch: int) -> List[int]:
         """Iteration order over this host's shard list for one epoch.
@@ -423,7 +389,7 @@ class TFRecordDataset:
 
     def _refill_scratch(self, fh, scratch, tail_len: int, path: str) -> int:
         """Fill scratch['buf'] after the carried tail; same bounded-carry
-        contract as ``_read_slab``. Returns the new valid length, or -1 at
+        contract as ``scan_spans_stream``. Returns the new valid length, or -1 at
         clean EOF; raises on truncation / absurd declared length."""
         buf = scratch["buf"]
         if tail_len >= 8:

@@ -17,6 +17,8 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
+import numpy as np
+
 from tpu_tfrecord import wire
 from tpu_tfrecord.infer import infer_from_records, merge_type_maps, type_map_to_schema
 from tpu_tfrecord.io import paths as p
@@ -102,6 +104,79 @@ class ShardReader:
             METRICS.add("read", records=records, nbytes=nbytes, seconds=seconds)
 
 
+def scan_spans_stream(
+    path: str,
+    verify_crc: bool,
+    slab_bytes: int = 32 << 20,
+    max_record_bytes: int = 1 << 30,
+    max_records: Optional[int] = None,
+    make_hint=None,
+) -> Iterator[tuple]:
+    """Stream one shard as (buf, offsets, lengths) span batches — the ONE
+    owner of the slab framing loop (bounded tail-carry: a partial trailing
+    frame carries into the next slab; a declared length beyond
+    max_record_bytes raises instead of buffering the rest of a corrupt
+    shard). Used by io/dataset's two-pass decode path and by span-batch
+    consumers like the native inference seqOp.
+
+    ``max_records`` stops cleanly after that many records WITHOUT framing or
+    CRC-checking the bytes beyond them — record-limited consumers (schema
+    inference sampling) thereby match the lazy per-record reader on shards
+    whose corruption lies past the limit. ``make_hint(fh)`` may return a
+    ``hint(pos)`` readahead callback (io/dataset wires its sliding
+    posix_fadvise window through this)."""
+    from tpu_tfrecord import _native
+
+    codec = wire.codec_from_path(path)
+    remaining = max_records
+    with wire.open_compressed(path, "rb", codec) as fh:
+        hint = make_hint(fh) if make_hint is not None else None
+        carry = b""
+        native = _native.available()
+        while remaining is None or remaining > 0:
+            if hint is not None:
+                try:
+                    hint(fh.tell())
+                except (AttributeError, OSError, ValueError):
+                    hint = None
+            want = slab_bytes
+            if len(carry) >= 8:
+                declared = int.from_bytes(carry[:8], "little")
+                if declared > max_record_bytes:
+                    raise wire.TFRecordCorruptionError(
+                        f"record length {declared} exceeds max_record_bytes "
+                        f"({max_record_bytes}) in {path} — corrupt length field?"
+                    )
+                want = max(want, 16 + declared - len(carry))
+            data = fh.read(want)
+            if not data:
+                if carry:
+                    raise wire.TFRecordCorruptionError(
+                        f"truncated TFRecord at end of {path}"
+                    )
+                return
+            buf = carry + data if carry else data
+            if native:
+                offsets, lengths, consumed = _native.scan_partial(
+                    buf, verify_crc, max_records=remaining
+                )
+            else:
+                spans, consumed = wire.scan_buffer_partial(
+                    buf, verify_crc, max_records=remaining
+                )
+                offsets = np.array([s for s, _ in spans], dtype=np.uint64)
+                lengths = np.array([l for _, l in spans], dtype=np.uint64)
+            if len(offsets) == 0:
+                # not even one complete record yet: keep accumulating
+                # (bounded by the declared-length check above)
+                carry = buf
+                continue
+            carry = buf[consumed:]
+            if remaining is not None:
+                remaining -= len(offsets)
+            yield buf, offsets, lengths
+
+
 class DatasetReader:
     """Plan + execute a read over many shards with partition merging.
 
@@ -159,6 +234,51 @@ class DatasetReader:
         columns excluded)."""
         return self.schema().drop(self._partition_cols)
 
+    _INFER_SLAB_BYTES = 32 << 20
+    # effectively uncapped: the per-record reader this path replaces reads
+    # records of ANY declared size, so inference must too — a real cap here
+    # would make schema results depend on whether the native build is active
+    _INFER_MAX_RECORD_BYTES = 1 << 62
+
+    def _shard_type_map(self, shard: Shard) -> Dict[str, Any]:
+        """One shard's seqOp: native wire-walk inference when available
+        (GIL-released C++, ~80x the Python oracle and the thing that makes
+        the thread-pooled all-files entry actually scale), Python oracle
+        otherwise. Both honor infer_sample_limit identically — the limit is
+        pushed into the span scan, so bytes past the sampled records are
+        never framed or CRC-checked (exactly like the lazy per-record
+        reader). Map parity pinned by tests/test_infer.py."""
+        from tpu_tfrecord import _native
+
+        limit = self.options.infer_sample_limit
+        if (
+            _native.available()
+            and self.options.record_type != RecordType.BYTE_ARRAY
+        ):
+            from tpu_tfrecord.infer import type_map_from_precedences
+
+            # With a small sample limit, a full-size slab would read (and on
+            # a cold store, fetch) far more than the sample needs — size the
+            # slab generously per record but keep the ceiling.
+            slab = self._INFER_SLAB_BYTES
+            if limit is not None:
+                slab = min(slab, max(1 << 20, 4096 * limit))
+            with _native.InferScanner(self.options.record_type) as scanner:
+                for buf, offsets, lengths in scan_spans_stream(
+                    shard.path,
+                    self.options.verify_crc,
+                    slab_bytes=slab,
+                    max_record_bytes=self._INFER_MAX_RECORD_BYTES,
+                    max_records=limit,
+                ):
+                    scanner.update(buf, offsets, lengths)
+                return type_map_from_precedences(scanner.result())
+        return infer_from_records(
+            wire.read_records(shard.path, verify_crc=self.options.verify_crc),
+            self.options.record_type,
+            limit=limit,
+        )
+
     def _infer_data_schema(self) -> StructType:
         """First non-empty file whose records yield a non-empty schema —
         single scan per candidate file (the reference scans the winning file
@@ -168,17 +288,10 @@ class DatasetReader:
             from tpu_tfrecord.infer import byte_array_schema
 
             return byte_array_schema()
-        limit = self.options.infer_sample_limit
         for shard in self.shards:
             if shard.size == 0:
                 continue
-            type_map = infer_from_records(
-                wire.read_records(
-                    shard.path, verify_crc=self.options.verify_crc
-                ),
-                self.options.record_type,
-                limit=limit,
-            )
+            type_map = self._shard_type_map(shard)
             if type_map:
                 return type_map_to_schema(type_map)
         raise ValueError(
@@ -188,17 +301,30 @@ class DatasetReader:
             else "Could not infer schema: no input files"
         )
 
-    def infer_schema_all_files(self) -> StructType:
+    def infer_schema_all_files(self, num_workers: int = 1) -> StructType:
         """Inference over EVERY shard with the distributed merge algebra —
         the standalone TensorFlowInferSchema entry (SURVEY.md §3.3), and the
-        per-host seqOp/combOp used by the multi-host path."""
+        per-host seqOp/combOp used by the multi-host path.
+
+        ``num_workers > 1`` runs the per-shard seqOp in a thread pool — the
+        within-host analog of the reference's executor-parallel RDD
+        aggregate (TensorFlowInferSchema.scala:40-43); record IO and CRC
+        release the GIL, so shards scan concurrently on a multi-core host.
+        Partials merge in shard order regardless of completion order, so
+        the result is identical to the serial scan."""
+
+        seq_op = self._shard_type_map
+        if num_workers > 1 and len(self.shards) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                max_workers=min(num_workers, len(self.shards))
+            ) as pool:
+                partials = list(pool.map(seq_op, self.shards))
+        else:
+            partials = map(seq_op, self.shards)
         merged: Dict[str, Any] = {}
-        for shard in self.shards:
-            partial = infer_from_records(
-                wire.read_records(shard.path, verify_crc=self.options.verify_crc),
-                self.options.record_type,
-                limit=self.options.infer_sample_limit,
-            )
+        for partial in partials:
             merged = merge_type_maps(merged, partial)
         return type_map_to_schema(merged)
 

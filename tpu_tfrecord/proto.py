@@ -341,6 +341,13 @@ def _parse_feature(buf, start: int, end: int) -> Feature:
                     pos = istart
                     while pos < iend:
                         raw, pos = _read_varint(buf, pos)
+                        if pos > iend:
+                            # a varint crossing the declared payload end is
+                            # malformed — reading on into whatever bytes
+                            # follow would silently fabricate a value
+                            raise ProtoDecodeError(
+                                "truncated varint in packed int64 list"
+                            )
                         values.append(_unsigned_to_i64(raw))
                 elif iwt == _WT_VARINT:  # unpacked
                     raw, _ = _read_varint(buf, istart)

@@ -221,7 +221,13 @@ def open_compressed(path: str, mode: str, codec: Optional[str]) -> BinaryIO:
     from tpu_tfrecord import fs as _fs
 
     if _fs.has_scheme(path):
-        raw = _fs.filesystem_for(path).open(path, mode)
+        fsys = _fs.filesystem_for(path)
+        if mode in ("rb", "r"):
+            # block-pipelined readahead for big remote objects (the Hadoop
+            # FS connector streaming the reference gets for free — L6)
+            raw = _fs.open_for_read(fsys, path)
+        else:
+            raw = fsys.open(path, mode)
     elif codec is None:
         return open(path, mode)  # noqa: SIM115  (local fast path)
     else:
@@ -558,16 +564,20 @@ class RecordReader:
 
 
 def scan_buffer_partial(
-    buf: bytes, verify_crc: bool = True
+    buf: bytes, verify_crc: bool = True, max_records: Optional[int] = None
 ) -> Tuple[List[Tuple[int, int]], int]:
     """Scan complete frames in a buffer; a record extending past the end is
     a TAIL (to carry into the next slab), not corruption. Returns
-    ([(offset, length), ...], consumed_bytes)."""
+    ([(offset, length), ...], consumed_bytes). ``max_records`` stops the
+    scan cleanly after that many records — bytes past them are neither
+    framed nor CRC-checked (same contract as the native scan_partial)."""
     spans: List[Tuple[int, int]] = []
     pos = 0
     n = len(buf)
     consumed = 0
     while pos < n:
+        if max_records is not None and len(spans) >= max_records:
+            break
         if pos + HEADER_BYTES > n:
             break
         (length,) = _LEN_STRUCT.unpack_from(buf, pos)
