@@ -281,6 +281,111 @@ SEQ_DIM = 16
 SEQ_BATCH = 1024
 
 
+def _remote_prefetch_probe() -> dict:
+    """Disclosed evidence for the remote readahead path (VERDICT r4 item 3):
+    stream one object through PrefetchReader over a simulated high-RTT link
+    (every range request pays a fixed latency; requests on independent
+    handles overlap, like real object-store GETs) vs a serial read loop
+    paying one RTT per block. The pipelined rate approaching
+    block_size*depth/RTT = the prefetcher saturates the link. Device-free,
+    ~2s; memory-backed so no network variance. Correctness (byte equality,
+    fault injection) is pinned in tests/test_fs.py — this records the
+    NUMBER next to the headline."""
+    try:
+        import fsspec  # noqa: F401
+    except ImportError:
+        return {"remote_skipped": "fsspec unavailable"}
+    import threading
+
+    from tpu_tfrecord import fs as tfs
+
+    rtt_s = float(os.environ.get("TFR_BENCH_REMOTE_RTT_S", 0.02))
+    block = int(os.environ.get("TFR_BENCH_REMOTE_BLOCK", 2 << 20))
+    depth = int(os.environ.get("TFR_BENCH_REMOTE_DEPTH", 4))
+    nbytes = 32 << 20
+    path = "memory://tfr-bench/remote.bin"
+    fsys = tfs.filesystem_for(path)
+    payload = np.random.default_rng(3).integers(0, 256, nbytes, np.uint8)
+    with fsys.open(path, "wb") as fh:
+        fh.write(payload.tobytes())
+
+    io_lock = threading.Lock()
+
+    class _LinkFile:
+        def __init__(self, inner):
+            self._inner = inner
+            self._pos = 0
+
+        def seek(self, pos, whence=0):
+            self._pos = pos
+
+        def read(self, size=-1):
+            time.sleep(rtt_s)  # per-request RTT, outside the lock
+            with io_lock:  # memory:// shares one cursor across handles
+                self._inner.seek(self._pos)
+                data = self._inner.read(size)
+            self._pos += len(data)
+            return data
+
+        def close(self):
+            pass
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            pass
+
+    class _LinkFS:
+        protocol = "simlink"  # independent handles: no serialization needed
+
+        def __init__(self, fs):
+            self._fs = fs
+
+        def open(self, p, mode):
+            # under io_lock: memory://'s _open seeks the SHARED file object
+            # to 0, which must not interleave with another handle's
+            # locked seek+read
+            with io_lock:
+                return _LinkFile(self._fs.open(p, mode))
+
+        def __getattr__(self, name):
+            return getattr(self._fs, name)
+
+    link = _LinkFS(fsys)
+
+    def drain_serial() -> float:
+        # loop the KNOWN block count: a read-until-empty loop would pay one
+        # extra RTT for the EOF probe that the pipelined path never issues,
+        # biasing the speedup upward (~1/nblocks)
+        t0 = time.perf_counter()
+        with link.open(path, "rb") as fh:
+            for _ in range((nbytes + block - 1) // block):
+                fh.read(block)
+        return nbytes / (time.perf_counter() - t0) / 1e6
+
+    def drain_pipelined() -> float:
+        t0 = time.perf_counter()
+        with tfs.PrefetchReader(link, path, nbytes, block, depth) as fh:
+            while fh.read(block):
+                pass
+        return nbytes / (time.perf_counter() - t0) / 1e6
+
+    serial_mbps = drain_serial()
+    pipe_mbps = drain_pipelined()
+    fsys.remove(path)
+    return {
+        # simulated-link streaming rates (MB/s) and the pipelining win;
+        # link ceiling = block*depth/RTT, serial floor = block/RTT
+        "remote_sim_rtt_ms": rtt_s * 1e3,
+        "remote_sim_serial_mbps": round(serial_mbps, 1),
+        "remote_sim_pipelined_mbps": round(pipe_mbps, 1),
+        "remote_sim_speedup": round(pipe_mbps / serial_mbps, 2),
+        "remote_sim_link_ceiling_mbps": round(block * depth / rtt_s / 1e6, 1),
+        "remote_prefetch_depth": depth,
+    }
+
+
 def seq_schema():
     from tpu_tfrecord.schema import (
         ArrayType, FloatType, LongType, StructField, StructType,
@@ -443,6 +548,10 @@ def main() -> None:
         # real disk IO in it (raw disk probe + one dropped-page-cache
         # pipeline pass, ~2s); set TFR_BENCH_COLD=0 to skip.
         cold_info = _cold_io_throughput(data_dir, schema, hash_buckets, pack)
+    remote_info = None
+    if os.environ.get("TFR_BENCH_REMOTE", "1") != "0":
+        # simulated-link remote readahead evidence (~2s, device-free)
+        remote_info = _remote_prefetch_probe()
 
     # Measurement attempts land here the moment they complete, so a guard
     # firing later (e.g. the train phase hanging on a dead tunnel) still
@@ -476,6 +585,8 @@ def main() -> None:
             }
             if cold_info is not None:
                 out.update(cold_info)
+            if remote_info is not None:
+                out.update(remote_info)
             print(json.dumps(out), flush=True)
             os._exit(0)
         err = {
@@ -487,6 +598,8 @@ def main() -> None:
         }
         if cold_info is not None:
             err.update(cold_info)
+        if remote_info is not None:
+            err.update(remote_info)
         print(json.dumps(err), flush=True)
         os._exit(3)
 
@@ -844,6 +957,9 @@ def main() -> None:
     if cold_info is not None:
         # dropped-page-cache pass + raw-disk disclosure (TFR_BENCH_COLD=1)
         out.update(cold_info)
+    if remote_info is not None:
+        # simulated-link remote readahead evidence (TFR_BENCH_REMOTE=1)
+        out.update(remote_info)
     if seq_info is not None:
         # ragged SequenceExample decode->pad->device secondary metric
         out.update(seq_info)

@@ -1,0 +1,102 @@
+"""Measured multi-worker decode scaling, recorded as a JSON artifact.
+
+The 1-core TPU bench box can never evidence the num_workers machinery's
+actual parallel speedup (VERDICT r4 item 8) — the scaling test that runs on
+multi-core CI is pass/fail only. This tool produces the tracked NUMBER: it
+generates a Criteo-shaped dataset, measures sustained decode throughput at
+num_workers = 1 and N (default: min(4, cores)), and prints one JSON line
+
+    {"metric": "decode_scaling", "workers": N, "t1_ex_s": ..., "tn_ex_s":
+     ..., "ratio": ..., "cores": ...}
+
+CI uploads this as the decode-scaling artifact next to the bench smoke.
+Exit code is 0 even for poor ratios on busy runners — the artifact records,
+the perf-tier test (tests/test_pipeline_features.py) enforces.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import tpu_tfrecord.io as tfio
+from tpu_tfrecord import _native
+from tpu_tfrecord.io.dataset import TFRecordDataset
+from tpu_tfrecord.schema import LongType, StringType, StructField, StructType
+
+SHARDS = int(os.environ.get("TFR_SCALING_SHARDS", 8))
+ROWS_PER_SHARD = int(os.environ.get("TFR_SCALING_ROWS", 20_000))
+WORKERS = int(os.environ.get("TFR_SCALING_WORKERS", 0)) or min(
+    4, os.cpu_count() or 1
+)
+BATCH = 8192
+if SHARDS * ROWS_PER_SHARD < 2 * BATCH:
+    raise SystemExit(
+        f"TFR_SCALING_SHARDS*TFR_SCALING_ROWS = {SHARDS * ROWS_PER_SHARD} "
+        f"rows yields < 2 batches of {BATCH} (warmup consumes one; the "
+        f"measurement needs at least one more) — raise the knobs"
+    )
+
+SCHEMA = StructType(
+    [StructField("label", LongType(), nullable=False)]
+    + [StructField(f"I{i}", LongType()) for i in range(1, 14)]
+    + [StructField(f"C{i}", StringType()) for i in range(1, 27)]
+)
+
+
+def make_dataset(out: str) -> None:
+    rng = np.random.default_rng(7)
+    for _ in range(SHARDS):
+        ints = rng.integers(0, 1 << 30, size=(ROWS_PER_SHARD, 14))
+        cats = rng.integers(0, 1 << 24, size=(ROWS_PER_SHARD, 26))
+        rows = [
+            [int(v) for v in ints[r]] + [f"{v:08x}" for v in cats[r]]
+            for r in range(ROWS_PER_SHARD)
+        ]
+        tfio.write(rows, SCHEMA, out, mode="append")
+
+
+def run(out: str, workers: int) -> float:
+    """Sustained decode throughput (ex/s), first batch excluded (warmup)."""
+    ds = TFRecordDataset(out, batch_size=BATCH, schema=SCHEMA, num_workers=workers)
+    with ds.batches() as it:
+        next(it)
+        t0 = time.perf_counter()
+        n = 0
+        for b in it:
+            n += b.num_rows
+        dt = time.perf_counter() - t0
+    return n / dt
+
+
+def main() -> None:
+    if not _native.available():
+        print(json.dumps({"metric": "decode_scaling", "skipped": "no native"}))
+        return
+    with tempfile.TemporaryDirectory(prefix="tfr_scaling_") as d:
+        out = os.path.join(d, "ds")
+        make_dataset(out)
+        t1 = max(run(out, 1), run(out, 1))
+        tn = max(run(out, WORKERS), run(out, WORKERS))
+    print(
+        json.dumps(
+            {
+                "metric": "decode_scaling",
+                "workers": WORKERS,
+                "t1_ex_s": round(t1),
+                "tn_ex_s": round(tn),
+                "ratio": round(tn / t1, 3),
+                "cores": os.cpu_count(),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
