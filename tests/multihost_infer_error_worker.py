@@ -1,0 +1,46 @@
+"""Worker: distributed schema inference where THIS host's slice may be
+corrupt. Proves the error-propagation contract of
+DatasetReader.infer_schema_multihost: a local seqOp failure rides the
+allgather instead of raising before it, so EVERY process raises the same
+DistributedInferenceError (naming the failed process) rather than the
+healthy peers hanging in the collective forever.
+
+argv: coord num_procs pid data_dir
+exit 7 = got the expected DistributedInferenceError; 1 = wrong outcome.
+"""
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    coord, num_procs, pid, data_dir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
+    )
+    from tpu_tfrecord.tpu import distributed
+
+    distributed.initialize(coord, num_procs, pid)
+
+    import tpu_tfrecord.io as tfio
+    from tpu_tfrecord.tpu.distributed import DistributedInferenceError
+
+    try:
+        schema = tfio.reader(data_dir).infer_schema_multihost(num_workers=2)
+    except DistributedInferenceError as e:
+        msg = str(e)
+        # every process must see the SAME error, naming the corrupt slice's
+        # owner (process 1 — the corrupt shard is second in sorted order)
+        assert "process 1" in msg, msg
+        assert "process 0" not in msg, msg
+        print(f"pid {pid}: propagated ok: {msg}")
+        sys.exit(7)
+    print(f"pid {pid}: unexpectedly succeeded: {schema}")
+    sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -36,7 +36,13 @@ def main() -> None:
     from tpu_tfrecord.options import RecordType
     from tpu_tfrecord.tpu.mesh import assign_shards, create_mesh
 
-    # --- distributed schema inference: per-host seqOp + allgather combOp ---
+    # --- distributed schema inference: per-host seqOp + allgather combOp,
+    # through the public entry (native seqOp + 2-worker thread pool), and
+    # the oracle fold cross-checked against it ---
+    import tpu_tfrecord.io as tfio
+
+    schema = tfio.reader(data_dir).infer_schema_multihost(num_workers=2)
+    distributed.assert_same_across_hosts(schema.json().encode(), "schema")
     shards = discover_shards(data_dir)
     mine = assign_shards(shards)
     local_map = {}
@@ -47,8 +53,8 @@ def main() -> None:
             wire.read_records(sh.path), RecordType.EXAMPLE
         )
         local_map = merge_type_maps(local_map, partial)
-    schema = distributed.merge_schema_across_hosts(local_map)
-    distributed.assert_same_across_hosts(schema.json().encode(), "schema")
+    oracle_schema = distributed.merge_schema_across_hosts(local_map)
+    assert oracle_schema == schema, (oracle_schema, schema)
 
     # --- global batch assembly across processes ---
     mesh = create_mesh()  # all global devices on 'data'

@@ -379,6 +379,10 @@ class TestMultiFileAndInference:
         serial = r.infer_schema_all_files()
         for workers in (2, 8):
             assert r.infer_schema_all_files(num_workers=workers) == serial
+        # single-process multihost entry: assign_shards keeps every shard,
+        # the allgather degrades to identity, result identical (the real
+        # >1-process leg runs in tests/test_multihost.py via the worker)
+        assert r.infer_schema_multihost(num_workers=2) == serial
 
     @pytest.mark.perf
     @pytest.mark.skipif(

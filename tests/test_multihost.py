@@ -141,3 +141,51 @@ def test_dryrun_multichip_multiprocess(monkeypatch):
 
     monkeypatch.setenv("TFR_DRYRUN_PROCS", "2")
     dryrun_multichip(8)  # raises on any child failure
+
+
+@pytest.mark.slow
+def test_infer_error_propagates_to_all_hosts(sandbox):
+    """A corrupt shard in ONE process's inference slice must fail EVERY
+    process with the same DistributedInferenceError naming the culprit —
+    not hang the healthy peers in the allgather (code-review r5 finding:
+    a pre-collective raise on one host deadlocks the rest)."""
+    data = str(sandbox / "mh_err")
+    for s in range(2):
+        tfio.write([[s * 10 + i] for i in range(8)],
+                   StructType([StructField("uid", LongType())]),
+                   data, mode="append")
+    # corrupt the SECOND part file in sorted order = process 1's slice
+    # (assign_shards interleaves the sorted global order)
+    parts = sorted(n for n in os.listdir(data) if n.startswith("part-"))
+    assert len(parts) == 2
+    victim = os.path.join(data, parts[1])
+    blob = bytearray(open(victim, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(victim, "wb").write(bytes(blob))
+
+    port = free_port()
+    coord = f"127.0.0.1:{port}"
+    worker = os.path.join(os.path.dirname(__file__), "multihost_infer_error_worker.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, coord, "2", str(i), data],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        )
+        for i in range(2)
+    ]
+    try:
+        for i, p in enumerate(procs):
+            try:
+                out, err = p.communicate(timeout=180)  # a hang fails here
+            except subprocess.TimeoutExpired:
+                pytest.fail("worker hung: inference error did not propagate")
+            assert p.returncode == 7, (
+                f"pid {i} rc={p.returncode}\nstdout:{out[-1000:]}\nstderr:{err[-1000:]}"
+            )
+    finally:
+        for q in procs:
+            if q.poll() is None:
+                q.kill()

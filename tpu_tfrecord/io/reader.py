@@ -301,32 +301,84 @@ class DatasetReader:
             else "Could not infer schema: no input files"
         )
 
-    def infer_schema_all_files(self, num_workers: int = 1) -> StructType:
-        """Inference over EVERY shard with the distributed merge algebra —
-        the standalone TensorFlowInferSchema entry (SURVEY.md §3.3), and the
-        per-host seqOp/combOp used by the multi-host path.
+    def local_type_map(
+        self, shards: Optional[Sequence[Shard]] = None, num_workers: int = 1
+    ) -> Dict[str, Any]:
+        """The per-host seqOp fold: type map over ``shards`` (default: all
+        of this reader's shards).
 
         ``num_workers > 1`` runs the per-shard seqOp in a thread pool — the
         within-host analog of the reference's executor-parallel RDD
-        aggregate (TensorFlowInferSchema.scala:40-43); record IO and CRC
-        release the GIL, so shards scan concurrently on a multi-core host.
+        aggregate (TensorFlowInferSchema.scala:40-43); the native wire walk
+        releases the GIL, so shards scan concurrently on a multi-core host.
         Partials merge in shard order regardless of completion order, so
         the result is identical to the serial scan."""
+        shards = self.shards if shards is None else list(shards)
 
-        seq_op = self._shard_type_map
-        if num_workers > 1 and len(self.shards) > 1:
+        def seq_op(shard: Shard):
+            try:
+                return self._shard_type_map(shard)
+            except Exception as e:
+                # annotate WHICH shard failed (wire errors don't all carry
+                # the path) without changing the exception type the callers
+                # pin (corruption tests expect TFRecordCorruptionError)
+                if (
+                    e.args
+                    and isinstance(e.args[0], str)
+                    and shard.path not in e.args[0]
+                ):
+                    e.args = (f"{e.args[0]} (shard {shard.path})",) + e.args[1:]
+                raise
+        if num_workers > 1 and len(shards) > 1:
             from concurrent.futures import ThreadPoolExecutor
 
             with ThreadPoolExecutor(
-                max_workers=min(num_workers, len(self.shards))
+                max_workers=min(num_workers, len(shards))
             ) as pool:
-                partials = list(pool.map(seq_op, self.shards))
+                partials = list(pool.map(seq_op, shards))
         else:
-            partials = map(seq_op, self.shards)
+            partials = map(seq_op, shards)
         merged: Dict[str, Any] = {}
         for partial in partials:
             merged = merge_type_maps(merged, partial)
-        return type_map_to_schema(merged)
+        return merged
+
+    def infer_schema_all_files(self, num_workers: int = 1) -> StructType:
+        """Inference over EVERY shard with the distributed merge algebra —
+        the standalone TensorFlowInferSchema entry (SURVEY.md §3.3), and the
+        per-host seqOp/combOp used by the multi-host path."""
+        return type_map_to_schema(self.local_type_map(num_workers=num_workers))
+
+    def infer_schema_multihost(self, num_workers: int = 1) -> StructType:
+        """Full multi-host distributed inference, the reference's RDD
+        ``aggregate`` end to end (TensorFlowInferSchema.scala:40-43): every
+        process folds the seqOp over ITS deterministic shard slice (the
+        same interleaved assignment the read path uses), then the partial
+        type maps allgather-merge so all hosts return the identical schema.
+        Requires jax.distributed to be initialized (single-process runs
+        degrade to the local fold + identity merge). A local scan failure
+        (corrupt shard, incompatible types within this slice) must NOT
+        raise before the collective — that would leave every peer blocked
+        in the allgather — so it rides the gather and re-raises on every
+        host as DistributedInferenceError."""
+        from tpu_tfrecord.tpu.distributed import merge_schema_across_hosts
+        from tpu_tfrecord.tpu.mesh import assign_shards
+
+        mine = assign_shards(self.shards)
+        local: Dict[str, Any] = {}
+        err: Optional[str] = None
+        exc: Optional[BaseException] = None
+        try:
+            local = self.local_type_map(mine, num_workers=num_workers)
+        except Exception as e:  # noqa: BLE001 — encoded into the collective
+            err = f"{type(e).__name__}: {e}"
+            exc = e
+        try:
+            return merge_schema_across_hosts(local, local_error=err)
+        except Exception as merged_err:
+            if exc is not None:
+                raise merged_err from exc  # keep the local traceback too
+            raise
 
     # -- execution ----------------------------------------------------------
 

@@ -81,14 +81,42 @@ def allgather_bytes(payload: bytes) -> List[bytes]:
     return [bytes(gathered[i, : int(lengths[i])].tobytes()) for i in range(len(lengths))]
 
 
-def merge_schema_across_hosts(local_type_map: TypeMap) -> StructType:
+class DistributedInferenceError(RuntimeError):
+    """One or more hosts' local inference seqOp failed; raised on EVERY
+    host after the allgather completes, naming the failed processes."""
+
+
+def merge_schema_across_hosts(
+    local_type_map: TypeMap, local_error: Optional[str] = None
+) -> StructType:
     """Distributed schema inference: allgather per-host partial type maps and
     fold them with the same combOp on every host (deterministic order ->
     identical result everywhere). The TPU-native analog of the reference's
-    RDD.aggregate combOp tree-merge (TensorFlowInferSchema.scala:40-43)."""
-    partials = [
-        _decode_type_map(p) for p in allgather_bytes(_encode_type_map(local_type_map))
+    RDD.aggregate combOp tree-merge (TensorFlowInferSchema.scala:40-43).
+
+    ``local_error``: if this host's local fold failed, pass the error string
+    INSTEAD of raising before the collective — a pre-collective raise on one
+    host leaves every peer blocked in the allgather forever. The error rides
+    the gather in the map's place and every host raises the same
+    DistributedInferenceError after the collective completes (the analog of
+    Spark failing the job when one aggregate task fails)."""
+    payload = (
+        b"E" + local_error.encode("utf-8", "replace")
+        if local_error is not None
+        else b"M" + _encode_type_map(local_type_map)
+    )
+    gathered = allgather_bytes(payload)
+    errors = [
+        (i, p[1:].decode("utf-8", "replace"))
+        for i, p in enumerate(gathered)
+        if p[:1] == b"E"
     ]
+    if errors:
+        detail = "; ".join(f"process {i}: {msg}" for i, msg in errors)
+        raise DistributedInferenceError(
+            f"schema inference failed on {len(errors)} process(es): {detail}"
+        )
+    partials = [_decode_type_map(p[1:]) for p in gathered]
     merged: TypeMap = {}
     for partial in partials:
         merged = merge_type_maps(merged, partial)
