@@ -111,6 +111,10 @@ def main() -> None:
     mesh = create_mesh({"data": data, "seq": seq}, jax.devices()[: data * seq])
     cfg = long_doc.LongDocConfig(
         seq_dim=SEQ_DIM, d_model=32, n_heads=4, n_layers=2, max_len=MAX_LEN,
+        # 'ring' (default) or 'ulysses' — n_heads=4 covers every seq size
+        # the picker above can choose, so both flavors run on any device
+        # count (LONGDOC_SP_ATTENTION=ulysses to exercise the all-to-all SP)
+        sp_attention=os.environ.get("LONGDOC_SP_ATTENTION", "ring"),
     )
     params = long_doc.init_params(jax.random.key(0), cfg)
     tx = optax.adam(1e-3)

@@ -212,3 +212,41 @@ class TestTraining:
         hlo = fn.lower(params, batch).compile().as_text()
         assert "collective-permute" in hlo
         assert "all-gather" not in hlo
+
+
+class TestUlyssesFlavor:
+    def test_ulysses_matches_dense_reference_end_to_end(self):
+        """cfg.sp_attention='ulysses' routes the blocks through the
+        all-to-all SP attention; logits must equal the dense oracle (and
+        therefore the ring flavor) on identical weights and batch. n_heads=2
+        covers the 2-way seq axis."""
+        import dataclasses
+
+        cfg = dataclasses.replace(CFG, sp_attention="ulysses")
+        mesh = _mesh(data=2, seq=2)
+        params = long_doc.init_params(jax.random.key(0), cfg)
+        hb = long_doc.make_synthetic_batch(cfg, 8, seed=1)
+        batch = {k: jnp.asarray(v) for k, v in hb.items()}
+        want = long_doc.forward(params, batch, cfg)  # dense reference
+        sh = long_doc.batch_shardings(mesh, hb)
+        sharded = {k: jax.device_put(v, sh[k]) for k, v in batch.items()}
+        got = jax.jit(
+            functools.partial(long_doc.forward, cfg=cfg, mesh=mesh, data_axis="data")
+        )(params, sharded)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+
+    def test_bad_flavor_rejected(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(CFG, sp_attention="flash")
+        with pytest.raises(ValueError, match="sp_attention"):
+            long_doc.init_params(jax.random.key(0), cfg)
+        # a config mutated AFTER init_params must fail in forward too, not
+        # silently run the ring flavor (code-review r5 finding)
+        params = long_doc.init_params(jax.random.key(0), CFG)
+        hb = long_doc.make_synthetic_batch(CFG, 4, seed=0)
+        batch = {k: jnp.asarray(v) for k, v in hb.items()}
+        with pytest.raises(ValueError, match="sp_attention"):
+            long_doc.forward(params, batch, cfg, mesh=_mesh(data=2, seq=2))

@@ -1,25 +1,32 @@
-"""Ring attention: sequence-parallel attention for long contexts.
+"""Sequence-parallel attention for long contexts: ring and all-to-all.
 
-The long-context compute primitive this framework's ingestion feeds: a
+The long-context compute primitives this framework's ingestion feeds: a
 sequence sharded over a mesh axis (the padded [B, L, ...] arrays produced by
 tpu_tfrecord.tpu.ingest with L on a 'seq' axis) attends over its FULL length
-while no device ever holds more than its L/P chunk of K/V.
+while no device ever holds more than its L/P chunk of the INPUT.
 
-TPU-idiomatic construction:
-- `shard_map` over the sequence axis; K/V blocks rotate around the ring with
-  `lax.ppermute` (neighbor hops ride the ICI torus; nothing goes through
-  host or DCN). The batch dim can stay sharded on a 'data' axis.
-- flash-style online softmax: running max / denominator / output accumulate
-  per step, so memory is O(L_chunk^2) per device instead of O(L^2), and the
-  result is EXACT (not an approximation).
-- the rotation runs p-1 times inside one `lax.fori_loop` (the final block
-  needs no outgoing hop), one compiled program, no data-dependent Python
-  control flow.
-- `lengths` masks padded key positions — the `<name>_len` arrays the ingest
-  layer emits plug in directly, so pad tokens never receive softmax mass.
+Two TPU-idiomatic constructions (SURVEY.md: "ring attention or all-to-all
+sequence/context parallelism"), same exact math, different collective
+pattern — pick by sequence length and head count:
 
-`ring_attention` is the sharded entry point; `attention_reference` is the
-plain dense oracle used by the tests.
+- `ring_attention`: `shard_map` over the sequence axis; K/V blocks rotate
+  around the ring with `lax.ppermute` (neighbor hops ride the ICI torus;
+  nothing goes through host or DCN). Flash-style online softmax keeps
+  per-device memory O(L_chunk^2), so it scales to sequences that do not
+  fit any single device. p-1 rotation steps inside one `lax.fori_loop`.
+- `ulysses_attention` (DeepSpeed-Ulysses pattern, arXiv:2309.14509):
+  two `lax.all_to_all` exchanges re-shard [B, L/p, H, D] -> [B, L, H/p, D],
+  each device runs DENSE attention over the full sequence for its H/p head
+  group, then the inverse exchange restores sequence sharding. Communication
+  is 2 all-to-alls of the activations — O(B*L*H*D/p) per device, constant in
+  p hops — vs the ring's p-1 K/V rotations, so it wins at moderate L with
+  enough heads; per-device scores are O(B * H/p * L^2), so VERY long
+  sequences still want the ring. Requires H % p == 0.
+
+Both accept `lengths` to mask padded key positions — the `<name>_len`
+arrays the ingest layer emits plug in directly, so pad tokens never receive
+softmax mass. `attention_reference` is the plain dense oracle used by the
+tests.
 """
 
 from __future__ import annotations
@@ -97,6 +104,32 @@ def _ring_attention_local(q, k, v, lengths, scale: float, axis_name: str):
     return out.astype(q.dtype)
 
 
+def _shard_map_attention(local_fn, q, k, v, mesh, seq_axis, data_axis, lengths, scale):
+    """Shared dispatch for both SP flavors: one shard_map over the sequence
+    axis (batch optionally on ``data_axis`` — an unsharded spec on a sharded
+    batch would silently gather it to every device), ``lengths`` riding
+    along per-batch when given."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    spec = P(data_axis, seq_axis, None, None)
+    if lengths is None:
+        fn = jax.shard_map(
+            functools.partial(
+                local_fn, lengths=None, scale=scale, axis_name=seq_axis
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )
+        return fn(q, k, v)
+    fn = jax.shard_map(
+        functools.partial(local_fn, scale=scale, axis_name=seq_axis),
+        mesh=mesh,
+        in_specs=(spec, spec, spec, P(data_axis)),
+        out_specs=spec,
+    )
+    return fn(q, k, v, lengths)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -110,28 +143,57 @@ def ring_attention(
     """Exact attention over a sequence sharded on ``mesh[seq_axis]``.
 
     q,k,v: [B, L, H, D] with L divisible by the axis size. Pass
-    ``data_axis`` to keep the batch dim sharded (otherwise it is treated as
-    replicated — an unsharded spec on a sharded batch would silently gather
-    it to every device). ``lengths`` [B] masks padded key positions (the
-    ingest layer's ``<name>_len`` output).
+    ``data_axis`` to keep the batch dim sharded. ``lengths`` [B] masks
+    padded key positions (the ingest layer's ``<name>_len`` output).
     """
-    scale = scale if scale is not None else q.shape[-1] ** -0.5
-    spec = P(data_axis, seq_axis, None, None)
-    len_spec = P(data_axis)
-    if lengths is None:
-        fn = jax.shard_map(
-            functools.partial(
-                _ring_attention_local, lengths=None, scale=scale, axis_name=seq_axis
-            ),
-            mesh=mesh,
-            in_specs=(spec, spec, spec),
-            out_specs=spec,
-        )
-        return fn(q, k, v)
-    fn = jax.shard_map(
-        functools.partial(_ring_attention_local, scale=scale, axis_name=seq_axis),
-        mesh=mesh,
-        in_specs=(spec, spec, spec, len_spec),
-        out_specs=spec,
+    return _shard_map_attention(
+        _ring_attention_local, q, k, v, mesh, seq_axis, data_axis, lengths, scale
     )
-    return fn(q, k, v, lengths)
+
+
+def _ulysses_attention_local(q, k, v, lengths, scale: float, axis_name: str):
+    """Per-device body (inside shard_map): q,k,v are the local sequence
+    chunks [B, Lc, H, D]. Two all-to-alls re-shard sequence<->heads; the
+    attention itself is plain dense math over the full sequence for this
+    device's H/p head group."""
+    # [B, Lc, H, D] -> [B, L, H/p, D]: every device sends each peer its
+    # chunk of that peer's head group — one tiled all_to_all on the ICI
+    qh, kh, vh = (
+        jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+        for x in (q, k, v)
+    )
+    out = attention_reference(qh, kh, vh, lengths=lengths, scale=scale)
+    # inverse exchange: [B, L, H/p, D] -> [B, Lc, H, D]
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    seq_axis: str = "seq",
+    data_axis: Optional[str] = None,
+    lengths: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention over a sequence sharded on ``mesh[seq_axis]`` via the
+    all-to-all (DeepSpeed-Ulysses) pattern — same contract and results as
+    :func:`ring_attention`, different collective/memory profile (see module
+    docstring for when to pick which).
+
+    q,k,v: [B, L, H, D] with L divisible by the axis size and H divisible by
+    the axis size (each device owns a head group while attending over the
+    full sequence). ``lengths`` [B] masks padded key positions.
+    """
+    p = mesh.shape[seq_axis]
+    h = q.shape[2]
+    if h % p:
+        raise ValueError(
+            f"ulysses_attention needs num_heads % mesh['{seq_axis}'] == 0 "
+            f"(got H={h}, axis size {p}); use ring_attention when heads "
+            f"cannot cover the sequence axis"
+        )
+    return _shard_map_attention(
+        _ulysses_attention_local, q, k, v, mesh, seq_axis, data_axis, lengths, scale
+    )

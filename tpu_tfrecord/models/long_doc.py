@@ -25,7 +25,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpu_tfrecord.models.attention import attention_reference, ring_attention
+from tpu_tfrecord.models.attention import (
+    attention_reference,
+    ring_attention,
+    ulysses_attention,
+)
 from tpu_tfrecord.models.dlrm import (
     _dense_init as _dlrm_dense_init,
     batch_shardings as _dlrm_batch_shardings,
@@ -42,6 +46,11 @@ class LongDocConfig:
     n_classes: int = 2
     max_len: int = 128       # padded sequence length (pad_to of the ingest)
     dtype: Any = jnp.bfloat16
+    # sequence-parallel attention flavor when a mesh is given: 'ring'
+    # (ppermute K/V rotation — any head count, O(Lc^2) memory) or
+    # 'ulysses' (2 all_to_alls + dense per head group — needs
+    # n_heads % seq_axis_size == 0; fewer collective hops at moderate L)
+    sp_attention: str = "ring"
     # rematerialize each block in backward (jax.checkpoint): activation
     # memory drops from O(n_layers * L) to O(L) at ~1.3x backward FLOPs —
     # the standard long-context trade when L is large
@@ -57,6 +66,10 @@ def init_params(rng: jax.Array, cfg: LongDocConfig) -> Dict[str, Any]:
     if cfg.d_model % cfg.n_heads:
         raise ValueError(
             f"n_heads ({cfg.n_heads}) must divide d_model ({cfg.d_model}) evenly"
+        )
+    if cfg.sp_attention not in ("ring", "ulysses"):
+        raise ValueError(
+            f"sp_attention must be 'ring' or 'ulysses', got {cfg.sp_attention!r}"
         )
     keys = jax.random.split(rng, 3 + cfg.n_layers)
     params: Dict[str, Any] = {
@@ -100,9 +113,12 @@ def forward(
     seq_axis: str = "seq",
     data_axis: Optional[str] = None,
 ) -> jax.Array:
-    """Logits [B, n_classes]. With ``mesh``, attention runs as ring
-    attention over ``seq_axis`` (SP); without, the dense reference — the
-    two are numerically equivalent (pinned by tests)."""
+    """Logits [B, n_classes]. With ``mesh``, attention runs sequence-
+    parallel over ``seq_axis`` in the flavor ``cfg.sp_attention`` selects
+    ('ring': ppermute K/V rotation, any head count; 'ulysses': 2
+    all_to_alls, needs n_heads % seq-axis size == 0); without a mesh, the
+    dense reference. All flavors are numerically equivalent (pinned by
+    tests)."""
     dt = cfg.dtype
     frames = batch["frames"].astype(dt)                    # [B, L, Din]
     lengths = batch["frames_len"]
@@ -118,7 +134,16 @@ def forward(
         k = k.reshape(b, l, h, dh)
         v = v.reshape(b, l, h, dh)
         if mesh is not None:
-            att = ring_attention(
+            if cfg.sp_attention == "ulysses":
+                sp = ulysses_attention
+            elif cfg.sp_attention == "ring":
+                sp = ring_attention
+            else:  # a config mutated after init_params must not silently
+                raise ValueError(  # run a different collective pattern
+                    f"sp_attention must be 'ring' or 'ulysses', got "
+                    f"{cfg.sp_attention!r}"
+                )
+            att = sp(
                 q, k, v, mesh, seq_axis=seq_axis, data_axis=data_axis,
                 lengths=lengths,
             )
