@@ -1,0 +1,84 @@
+"""MoE layer with expert parallelism vs the per-token oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_tfrecord.models import moe
+from tpu_tfrecord.tpu import create_mesh
+
+CFG = moe.MoEConfig(d_model=16, d_ff=32, n_experts=4, capacity_factor=1.25)
+
+
+def setup(b=4, t=20, seed=0, cfg=CFG):
+    params = moe.init_params(jax.random.key(0), cfg)
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(size=(b, t, cfg.d_model)), dtype=jnp.float32)
+    return params, x
+
+
+class TestMoE:
+    def test_matches_per_token_oracle(self):
+        params, x = setup()
+        y, aux = jax.jit(lambda p, x: moe.moe_apply(p, x, CFG))(params, x)
+        want = moe.moe_reference(params, x, CFG)
+        np.testing.assert_allclose(np.asarray(y), want, rtol=1e-4, atol=1e-5)
+        assert float(aux) > 0  # load-balance loss is positive by construction
+
+    def test_capacity_drops_tokens_in_arrival_order(self):
+        """With capacity_factor tiny, late tokens routed to a full expert
+        contribute ZERO (they ride the residual outside the layer) — the
+        oracle implements the drop rule independently."""
+        cfg = moe.MoEConfig(d_model=16, d_ff=32, n_experts=4, capacity_factor=0.3)
+        params, x = setup(cfg=cfg)
+        y, _ = jax.jit(lambda p, x: moe.moe_apply(p, x, cfg))(params, x)
+        want = moe.moe_reference(params, x, cfg)
+        np.testing.assert_allclose(np.asarray(y), want, rtol=1e-4, atol=1e-5)
+        # some tokens must actually have been dropped for this test to bite
+        flat = np.asarray(y).reshape(-1, cfg.d_model)
+        assert (np.abs(flat).sum(axis=-1) == 0).any()
+
+    def test_expert_parallel_sharding_matches(self):
+        """Experts sharded over the 'model' axis (EP): same numbers, expert
+        weights never replicated."""
+        mesh = create_mesh({"data": 2, "model": 4})
+        params, x = setup()
+        want = moe.moe_reference(params, x, CFG)
+        sh = moe.param_shardings(mesh, expert_axis="model")
+        p_sh = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+        x_sh = jax.device_put(x, NamedSharding(mesh, P("data", None, None)))
+        y, _ = jax.jit(lambda p, x: moe.moe_apply(p, x, CFG))(p_sh, x_sh)
+        np.testing.assert_allclose(np.asarray(y), want, rtol=1e-4, atol=1e-5)
+        # the expert dim of the weights is genuinely partitioned: each
+        # device holds E / axis_size experts, not all E (a regression to
+        # replicated would show the full expert dim per shard)
+        assert p_sh["w_in"].sharding.spec[0] == "model"
+        shard = p_sh["w_in"].addressable_shards[0].data
+        assert shard.shape[0] == CFG.n_experts // mesh.shape["model"]
+
+    def test_grads_flow_and_match_shardings(self):
+        mesh = create_mesh({"data": 2, "model": 4})
+        params, x = setup()
+        sh = moe.param_shardings(mesh, expert_axis="model")
+        p_sh = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+
+        def loss(p, x):
+            y, aux = moe.moe_apply(p, x, CFG)
+            return (y**2).sum() + 0.01 * aux
+
+        g = jax.jit(jax.grad(loss))(p_sh, x)
+        g_ref = jax.grad(loss)(params, x)
+        for k in g:
+            np.testing.assert_allclose(
+                np.asarray(g[k]), np.asarray(g_ref[k]), rtol=1e-4, atol=1e-5
+            )
+
+    def test_bf16_compute(self):
+        cfg = moe.MoEConfig(d_model=16, d_ff=32, n_experts=4, dtype=jnp.bfloat16)
+        params, x = setup(cfg=cfg)
+        y, _ = moe.moe_apply(params, x, cfg)
+        assert y.dtype == x.dtype  # output in the input dtype
+        want = moe.moe_reference(params, x, cfg)
+        np.testing.assert_allclose(np.asarray(y), want, rtol=5e-2, atol=5e-2)

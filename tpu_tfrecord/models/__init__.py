@@ -9,8 +9,17 @@ families are in-tree:
   embedding tables and hidden layers on 'model' (TP), and padded sequence
   features on 'seq' (SP).
 - ``long_doc``: a transformer-style long-document classifier whose
-  attention runs as ring attention over the 'seq' axis — the long-context
-  consumer of SequenceExample ingestion (``frames``/``frames_len``).
+  attention runs sequence-parallel over the 'seq' axis (ring or Ulysses
+  all-to-all, ``LongDocConfig.sp_attention``) — the long-context consumer
+  of SequenceExample ingestion (``frames``/``frames_len``).
+- ``moe``: a Switch-style Mixture-of-Experts FFN with expert parallelism
+  (expert-stacked weights sharded over a mesh axis, static-shape one-hot
+  dispatch/combine).
+- ``pipeline``: GPipe-style pipeline parallelism (stage weights sharded
+  one-per-device on a 'pipe' axis, microbatches hop via ppermute).
+
+Together the families exercise dp, tp, sp, ep, and pp on one mesh design
+(all five run inside ``__graft_entry__.dryrun_multichip``).
 
 The package-level flat names (init_params/forward/train_step/...) are the
 DLRM family's, kept for compatibility; each family's full API lives on its
@@ -19,7 +28,7 @@ with a specific family, the function names intentionally mirror each
 other.
 """
 
-from tpu_tfrecord.models import dlrm, long_doc
+from tpu_tfrecord.models import dlrm, long_doc, moe, pipeline
 from tpu_tfrecord.models.dlrm import (
     DLRMConfig,
     SparseEmbOptState,
@@ -36,6 +45,8 @@ from tpu_tfrecord.models.dlrm import (
 __all__ = [
     "dlrm",
     "long_doc",
+    "moe",
+    "pipeline",
     "DLRMConfig",
     "init_params",
     "forward",

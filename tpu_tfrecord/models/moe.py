@@ -1,0 +1,151 @@
+"""Mixture-of-Experts layer with expert parallelism (EP) over a mesh axis.
+
+The reference framework ships no model code (SURVEY.md §2: parallelism rows
+beyond DP are N/A) — this is the EP member of the consumer-model family
+that exercises the ingestion pipeline under every parallelism style the
+mesh supports (dp/tp/sp are covered by models.dlrm and models.attention;
+pp by models.pipeline).
+
+TPU-first construction (the Switch-Transformer / Mesh-TensorFlow dispatch
+formulation, arXiv:2101.03961 §2.2):
+- top-1 routing with a FIXED per-expert capacity: every tensor keeps a
+  static shape, so the whole layer jits once and lands on the MXU as three
+  einsums (dispatch, expert FFN, combine) — no gather/scatter with
+  data-dependent shapes, no host round trips.
+- dispatch/combine are one-hot einsums: tokens beyond an expert's capacity
+  contribute zero to the combine (dropped tokens ride the residual
+  connection — exactly the Switch behavior).
+- EP = the expert-indexed [E, ...] tensors sharded over a mesh axis via
+  NamedSharding; under jit, XLA inserts the collectives that move tokens
+  between the data and expert shardings per its cost model (all-to-all on
+  pod shapes, gather/reduce on small ones) — the role the torch
+  implementations hand-roll with NCCL alltoall. Expert weights never
+  replicate; that is what makes it EP.
+- the router adds the standard load-balance auxiliary loss (mean fraction
+  * mean router prob per expert, scaled by E) so training spreads tokens.
+
+`moe_apply` is the layer; `moe_reference` is the per-token oracle used by
+the tests; `param_shardings` places the expert tensors on the EP axis.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    d_model: int = 32
+    d_ff: int = 64          # per-expert hidden width
+    n_experts: int = 4
+    # capacity = ceil(tokens/expert * factor); 1.0 = perfectly balanced
+    # routing just fits, >1 gives slack before drops (Switch default 1.25)
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+
+
+def init_params(rng: jax.Array, cfg: MoEConfig) -> Dict[str, Any]:
+    kr, k1, k2 = jax.random.split(rng, 3)
+    scale_in = (2.0 / cfg.d_model) ** 0.5
+    scale_out = (2.0 / cfg.d_ff) ** 0.5
+    return {
+        "router": jax.random.normal(kr, (cfg.d_model, cfg.n_experts)) * 0.02,
+        # expert-stacked FFN weights: [E, ...] is the EP-sharded dim
+        "w_in": jax.random.normal(k1, (cfg.n_experts, cfg.d_model, cfg.d_ff))
+        * scale_in,
+        "w_out": jax.random.normal(k2, (cfg.n_experts, cfg.d_ff, cfg.d_model))
+        * scale_out,
+    }
+
+
+def param_shardings(mesh: Mesh, expert_axis: str = "model") -> Dict[str, Any]:
+    """NamedShardings placing the expert dim on ``expert_axis`` (router
+    replicated). Apply with jax.device_put / as jit out_shardings."""
+    return {
+        "router": NamedSharding(mesh, P()),
+        "w_in": NamedSharding(mesh, P(expert_axis, None, None)),
+        "w_out": NamedSharding(mesh, P(expert_axis, None, None)),
+    }
+
+
+def _capacity(tokens: int, cfg: MoEConfig) -> int:
+    # ceil, per the config contract: factor 1.0 must JUST FIT perfectly
+    # balanced routing (floor would drop tokens even when balanced)
+    cap = -(-int(tokens * cfg.capacity_factor) // cfg.n_experts)
+    return max(1, cap)
+
+
+def moe_apply(
+    params: Dict[str, Any],
+    x: jax.Array,
+    cfg: MoEConfig,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-1 MoE FFN. x: [..., T, D] (leading dims flattened internally).
+    Returns (y, aux_loss) with y.shape == x.shape; dropped tokens yield 0
+    (add the residual outside). All shapes static — jits once.
+    """
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    xt = x.reshape(-1, d)                                     # [T, D]
+    t = xt.shape[0]
+    e = cfg.n_experts
+    c = _capacity(t, cfg)
+
+    logits = xt.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                    # [T, E]
+    expert = jnp.argmax(probs, axis=-1)                        # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]  # [T]
+
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)      # [T, E]
+    # position of each token within its expert's queue (0-based)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0            # [T, E]
+    kept = (pos < c) & (onehot > 0)                            # [T, E]
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), c, dtype=jnp.float32)
+    dispatch = jnp.where(kept[..., None], pos_oh, 0.0)         # [T, E, C]
+    combine = dispatch * gate[:, None, None]                   # [T, E, C]
+
+    # load-balance aux loss (Switch eq. 4): E * mean(frac_tokens * mean_prob)
+    frac = onehot.mean(axis=0)                                 # [E]
+    mean_prob = probs.mean(axis=0)                              # [E]
+    aux = (frac * mean_prob).sum() * e
+
+    dt = cfg.dtype
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(dt), xt.astype(dt))
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, params["w_in"].astype(dt)))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_out"].astype(dt))
+    y = jnp.einsum("tec,ecd->td", combine.astype(dt), expert_out)
+    return y.reshape(orig_shape).astype(x.dtype), aux.astype(jnp.float32)
+
+
+def moe_reference(params: Dict[str, Any], x: jax.Array, cfg: MoEConfig) -> jax.Array:
+    """Per-token oracle: route each token to its argmax expert's FFN, gate
+    by the router prob, drop tokens beyond capacity in arrival order —
+    definitionally what moe_apply's einsum dance computes."""
+    import numpy as np
+
+    xt = np.asarray(x, dtype=np.float64).reshape(-1, x.shape[-1])
+    router = np.asarray(params["router"], dtype=np.float64)
+    w_in = np.asarray(params["w_in"], dtype=np.float64)
+    w_out = np.asarray(params["w_out"], dtype=np.float64)
+    t = xt.shape[0]
+    cap = _capacity(t, cfg)
+    logits = xt @ router
+    z = np.exp(logits - logits.max(axis=-1, keepdims=True))
+    probs = z / z.sum(axis=-1, keepdims=True)
+    expert = probs.argmax(axis=-1)
+    counts = {ei: 0 for ei in range(cfg.n_experts)}
+    out = np.zeros_like(xt)
+    for i in range(t):
+        ei = int(expert[i])
+        if counts[ei] >= cap:
+            continue
+        counts[ei] += 1
+        h = xt[i] @ w_in[ei]
+        h = 0.5 * h * (1.0 + np.tanh(np.sqrt(2 / np.pi) * (h + 0.044715 * h**3)))
+        out[i] = probs[i, ei] * (h @ w_out[ei])
+    return out.reshape(x.shape)

@@ -1,0 +1,120 @@
+"""Pipeline parallelism (PP) over a mesh axis: GPipe-style microbatching.
+
+The reference framework has no model-side parallelism (SURVEY.md §2) — this
+is the PP member of the consumer-model family, completing the dp/tp/sp/ep/pp
+set the mesh design supports (dlrm: dp×tp×sp, attention: sp, moe: ep).
+
+TPU-idiomatic construction (the collective-permute pipeline from the
+public scaling playbook, jax-ml.github.io/scaling-book — NOT a torch-style
+send/recv scheduler):
+- `shard_map` over the ``pipe`` axis; each device holds ONE stage's
+  parameters (the stacked [S, ...] stage pytree is sharded on its leading
+  dim, so stage weights never replicate — that is what makes it PP).
+- M microbatches flow through S stages in M + S - 1 ticks inside one
+  `lax.fori_loop` (static trip count → one compiled program, reverse-mode
+  differentiable via scan); activations hop device s -> s+1 with
+  `lax.ppermute` each tick, riding neighbor ICI links.
+- the classic bubble: S - 1 of the ticks per device are idle warmup/drain.
+  Efficiency = M / (M + S - 1) — callers pick M accordingly.
+- outputs accumulate on the last stage and replicate with one `psum`
+  (devices other than the last contribute zeros).
+
+`pipeline_apply` is the sharded entry point; `pipeline_reference` is the
+sequential oracle used by the tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+StageFn = Callable[[Any, jax.Array], jax.Array]
+
+
+def pipeline_reference(stage_fn: StageFn, stage_params: Any, xs: jax.Array) -> jax.Array:
+    """Sequential oracle: fold every microbatch through all S stages.
+    stage_params: pytree stacked on a leading S dim; xs: [M, mb, ...]."""
+    n_stages = jax.tree.leaves(stage_params)[0].shape[0]
+
+    def one(x):
+        for s in range(n_stages):
+            params_s = jax.tree.map(lambda a: a[s], stage_params)
+            x = stage_fn(params_s, x)
+        return x
+
+    return jax.vmap(one)(xs)
+
+
+def _pipeline_local(params_stk, xs, *, stage_fn: StageFn, n_micro: int, axis: str):
+    """Per-device body (inside shard_map): params_stk is THIS stage's slice
+    (leading dim 1); xs is the full replicated [M, mb, ...] input."""
+    params = jax.tree.map(lambda a: a[0], params_stk)
+    s = jax.lax.axis_index(axis)
+    n_stages = jax.lax.axis_size(axis)
+    perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+    mb_shape = xs.shape[1:]
+    # the loop writes device-varying values into these, so their types must
+    # be pipe-varying from the start (xs is replicated -> unvarying)
+    carry0 = jax.lax.pcast(jnp.zeros(mb_shape, xs.dtype), (axis,), to="varying")
+    out0 = jax.lax.pcast(
+        jnp.zeros((n_micro,) + mb_shape, xs.dtype), (axis,), to="varying"
+    )
+
+    def tick(t, state):
+        carry, outbuf = state
+        # stage 0 injects microbatch t (clipped reads past M compute
+        # garbage that the output mask below never collects)
+        inp = jnp.where(s == 0, xs[jnp.clip(t, 0, n_micro - 1)], carry)
+        out = stage_fn(params, inp)
+        m = t - (n_stages - 1)  # the microbatch the LAST stage just finished
+        write = (s == n_stages - 1) & (m >= 0)
+        mc = jnp.clip(m, 0, n_micro - 1)
+        outbuf = outbuf.at[mc].set(jnp.where(write, out, outbuf[mc]))
+        carry = jax.lax.ppermute(out, axis, perm)  # hop to the next stage
+        return carry, outbuf
+
+    _, outbuf = jax.lax.fori_loop(
+        0, n_micro + n_stages - 1, tick, (carry0, out0)
+    )
+    # only the last stage wrote; psum replicates the result everywhere
+    return jax.lax.psum(outbuf, axis)
+
+
+def pipeline_apply(
+    stage_fn: StageFn,
+    stage_params: Any,
+    xs: jax.Array,
+    mesh: Mesh,
+    pipe_axis: str = "pipe",
+) -> jax.Array:
+    """Run M microbatches through S pipeline stages sharded on
+    ``mesh[pipe_axis]``.
+
+    stage_params: pytree whose leaves are stacked [S, ...] (S = axis size);
+    every stage must map shape [mb, ...] -> [mb, ...] (same shape, so the
+    activation hop is shape-stable). xs: [M, mb, ...]. Returns [M, mb, ...],
+    bitwise the sequential composition (pinned by tests).
+    """
+    n_stages = mesh.shape[pipe_axis]
+    leaves = jax.tree.leaves(stage_params)
+    if not leaves or any(l.shape[0] != n_stages for l in leaves):
+        bad = [l.shape for l in leaves if l.shape[0] != n_stages]
+        raise ValueError(
+            f"stage_params leaves must stack {n_stages} stages on the "
+            f"leading dim (mesh['{pipe_axis}']); offending leaf shapes: "
+            f"{bad or 'no leaves'}"
+        )
+    n_micro = xs.shape[0]
+    fn = jax.shard_map(
+        functools.partial(
+            _pipeline_local, stage_fn=stage_fn, n_micro=n_micro, axis=pipe_axis
+        ),
+        mesh=mesh,
+        in_specs=(P(pipe_axis), P()),
+        out_specs=P(),
+    )
+    return fn(stage_params, xs)
