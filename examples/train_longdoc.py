@@ -115,6 +115,8 @@ def main() -> None:
         # the picker above can choose, so both flavors run on any device
         # count (LONGDOC_SP_ATTENTION=ulysses to exercise the all-to-all SP)
         sp_attention=os.environ.get("LONGDOC_SP_ATTENTION", "ring"),
+        # LONGDOC_MOE_EXPERTS=4 swaps the FFN for the Switch MoE layer
+        moe_experts=int(os.environ.get("LONGDOC_MOE_EXPERTS", "0")),
     )
     params = long_doc.init_params(jax.random.key(0), cfg)
     tx = optax.adam(1e-3)

@@ -44,6 +44,26 @@ class TestForward:
             np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
         )
 
+    def test_padding_is_inert_moe_flavor(self):
+        """The MoE FFN must keep the dense flavor's contract: logits (and
+        the aux loss) depend ONLY on valid positions — padding content must
+        neither route through experts nor consume their capacity."""
+        import dataclasses
+
+        cfg = dataclasses.replace(CFG, moe_experts=4, moe_capacity_factor=0.5)
+        params = long_doc.init_params(jax.random.key(0), cfg)
+        hb = long_doc.make_synthetic_batch(cfg, 8, seed=5)
+        batch = {k: jnp.asarray(v) for k, v in hb.items()}
+        base, aux_base = long_doc.forward(params, batch, cfg, with_aux=True)
+        frames = np.asarray(batch["frames"]).copy()
+        lengths = np.asarray(batch["frames_len"])
+        for i, n in enumerate(lengths):
+            frames[i, n:] = 1e3  # garbage in every padded position
+        poisoned = dict(batch, frames=jnp.asarray(frames))
+        got, aux_got = long_doc.forward(params, poisoned, cfg, with_aux=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(base), rtol=1e-5)
+        np.testing.assert_allclose(float(aux_got), float(aux_base), rtol=1e-6)
+
     def test_padding_is_inert(self):
         """Changing bytes past frames_len must not change the logits."""
         params = long_doc.init_params(jax.random.key(0), CFG)
@@ -250,3 +270,85 @@ class TestUlyssesFlavor:
         batch = {k: jnp.asarray(v) for k, v in hb.items()}
         with pytest.raises(ValueError, match="sp_attention"):
             long_doc.forward(params, batch, cfg, mesh=_mesh(data=2, seq=2))
+
+
+class TestMoEFlavor:
+    """moe_experts > 0 swaps the blocks' FFN for the Switch MoE layer
+    (models.moe) — SP attention and EP FFN compose in one model."""
+
+    def _cfg(self, **kw):
+        import dataclasses
+
+        return dataclasses.replace(
+            CFG, moe_experts=4, moe_aux_weight=0.01, **kw
+        )
+
+    def test_aux_loss_flows(self):
+        cfg = self._cfg()
+        params = long_doc.init_params(jax.random.key(0), cfg)
+        assert "moe" in params["layers"][0] and "mlp_in" not in params["layers"][0]
+        hb = long_doc.make_synthetic_batch(cfg, 8, seed=1)
+        batch = {k: jnp.asarray(v) for k, v in hb.items()}
+        logits, aux = long_doc.forward(params, batch, cfg, with_aux=True)
+        assert logits.shape == (8, cfg.n_classes)
+        assert float(aux) > 0  # load-balance loss accumulated across layers
+        # dense flavor reports exactly zero aux
+        dp = long_doc.init_params(jax.random.key(0), CFG)
+        _, aux0 = long_doc.forward(dp, batch, CFG, with_aux=True)
+        assert float(aux0) == 0.0
+
+    def test_ep_sharded_params_match_replicated(self):
+        from tpu_tfrecord.models import moe as moe_mod
+
+        cfg = self._cfg()
+        mesh = create_mesh({"data": 2, "seq": 2, "expert": 2})
+        params = long_doc.init_params(jax.random.key(0), cfg)
+        hb = long_doc.make_synthetic_batch(cfg, 8, seed=2)
+        batch = {k: jnp.asarray(v) for k, v in hb.items()}
+        want = long_doc.forward(params, batch, cfg)
+        sh = moe_mod.param_shardings(mesh, expert_axis="expert")
+        p_sh = dict(params)
+        p_sh["layers"] = [
+            {**layer, "moe": {k: jax.device_put(v, sh[k]) for k, v in layer["moe"].items()}}
+            for layer in params["layers"]
+        ]
+        got = jax.jit(
+            functools.partial(long_doc.forward, cfg=cfg)
+        )(p_sh, batch)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+
+    def test_moe_longdoc_trains_on_sp_mesh(self):
+        """Full composition: SP attention (mesh 'seq' axis) + EP-SHARDED
+        experts (mesh 'expert' axis) + aux loss in ONE jit train step;
+        loss must decrease and the experts must stay partitioned."""
+        import optax
+
+        from tpu_tfrecord.models import moe as moe_mod
+
+        cfg = self._cfg()
+        mesh = create_mesh({"data": 2, "seq": 2, "expert": 2})
+        params = long_doc.init_params(jax.random.key(0), cfg)
+        esh = moe_mod.param_shardings(mesh, expert_axis="expert")
+        params["layers"] = [
+            {**ly, "moe": {k: jax.device_put(v, esh[k]) for k, v in ly["moe"].items()}}
+            for ly in params["layers"]
+        ]
+        tx = optax.adam(3e-3)
+        opt = tx.init(params)
+        hb = long_doc.make_synthetic_batch(cfg, 16, seed=3)
+        batch = {k: jnp.asarray(v) for k, v in hb.items()}
+        step = jax.jit(
+            functools.partial(
+                long_doc.train_step, cfg=cfg, tx=tx, mesh=mesh, data_axis="data"
+            )
+        )
+        first = None
+        for _ in range(30):
+            params, opt, loss = step(params, opt, batch)
+            first = first if first is not None else float(loss)
+        assert float(loss) < first, (first, float(loss))
+        # the updated expert weights are still EP-partitioned, not gathered
+        w = params["layers"][0]["moe"]["w_in"]
+        assert w.addressable_shards[0].data.shape[0] == cfg.moe_experts // 2

@@ -27,6 +27,29 @@ class TestMoE:
         np.testing.assert_allclose(np.asarray(y), want, rtol=1e-4, atol=1e-5)
         assert float(aux) > 0  # load-balance loss is positive by construction
 
+    def test_valid_mask_excludes_padding_everywhere(self):
+        """Masked (padding) tokens must not route, consume capacity, or
+        feed the aux loss — outputs and aux depend only on valid content.
+        Oracle implements the skip independently."""
+        cfg = moe.MoEConfig(d_model=16, d_ff=32, n_experts=4, capacity_factor=0.5)
+        params, x = setup(cfg=cfg)
+        rng = np.random.default_rng(9)
+        valid = jnp.asarray(rng.random(x.shape[:-1]) < 0.6)
+        y, aux = jax.jit(
+            lambda p, x, v: moe.moe_apply(p, x, cfg, valid=v)
+        )(params, x, valid)
+        want = moe.moe_reference(params, x, cfg, valid=valid)
+        np.testing.assert_allclose(np.asarray(y), want, rtol=1e-4, atol=1e-5)
+        # invalid rows are exactly zero
+        assert np.abs(np.asarray(y)[~np.asarray(valid)]).max() == 0.0
+        # poisoning ONLY the masked positions changes nothing
+        x2 = jnp.where(valid[..., None], x, 1e3)
+        y2, aux2 = jax.jit(
+            lambda p, x, v: moe.moe_apply(p, x, cfg, valid=v)
+        )(params, x2, valid)
+        np.testing.assert_allclose(np.asarray(y2), np.asarray(y), rtol=1e-5)
+        np.testing.assert_allclose(float(aux2), float(aux), rtol=1e-6)
+
     def test_capacity_drops_tokens_in_arrival_order(self):
         """With capacity_factor tiny, late tokens routed to a full expert
         contribute ZERO (they ride the residual outside the layer) — the

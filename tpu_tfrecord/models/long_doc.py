@@ -18,13 +18,14 @@ donated state); no data-dependent Python control flow.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_tfrecord.models import moe as _moe
 from tpu_tfrecord.models.attention import (
     attention_reference,
     ring_attention,
@@ -55,6 +56,15 @@ class LongDocConfig:
     # memory drops from O(n_layers * L) to O(L) at ~1.3x backward FLOPs —
     # the standard long-context trade when L is large
     remat: bool = False
+    # > 0 swaps every block's dense FFN for a Switch-style MoE with this
+    # many experts (models.moe; d_ff = mlp_mult * d_model per expert). The
+    # load-balance aux losses accumulate across layers and join the
+    # objective scaled by moe_aux_weight. Expert weights live at
+    # params['layers'][i]['moe'] — place them on a mesh axis with
+    # moe.param_shardings for EP.
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
 
 def _dense_init(rng, fan_in: int, fan_out: int):
@@ -82,16 +92,32 @@ def init_params(rng: jax.Array, cfg: LongDocConfig) -> Dict[str, Any]:
     layers = []
     for i in range(cfg.n_layers):
         k = jax.random.split(keys[3 + i], 4)
-        layers.append(
-            {
-                "qkv": _dense_init(k[0], cfg.d_model, 3 * cfg.d_model),
-                "proj": _dense_init(k[1], cfg.d_model, cfg.d_model),
-                "mlp_in": _dense_init(k[2], cfg.d_model, cfg.mlp_mult * cfg.d_model),
-                "mlp_out": _dense_init(k[3], cfg.mlp_mult * cfg.d_model, cfg.d_model),
-            }
-        )
+        layer = {
+            "qkv": _dense_init(k[0], cfg.d_model, 3 * cfg.d_model),
+            "proj": _dense_init(k[1], cfg.d_model, cfg.d_model),
+        }
+        if cfg.moe_experts > 0:
+            layer["moe"] = _moe.init_params(k[2], _moe_cfg(cfg))
+        else:
+            layer["mlp_in"] = _dense_init(
+                k[2], cfg.d_model, cfg.mlp_mult * cfg.d_model
+            )
+            layer["mlp_out"] = _dense_init(
+                k[3], cfg.mlp_mult * cfg.d_model, cfg.d_model
+            )
+        layers.append(layer)
     params["layers"] = layers
     return params
+
+
+def _moe_cfg(cfg: LongDocConfig) -> "_moe.MoEConfig":
+    return _moe.MoEConfig(
+        d_model=cfg.d_model,
+        d_ff=cfg.mlp_mult * cfg.d_model,
+        n_experts=cfg.moe_experts,
+        capacity_factor=cfg.moe_capacity_factor,
+        dtype=cfg.dtype,
+    )
 
 
 def _dense(layer, x, dt):
@@ -112,13 +138,18 @@ def forward(
     mesh: Optional[Mesh] = None,
     seq_axis: str = "seq",
     data_axis: Optional[str] = None,
-) -> jax.Array:
+    with_aux: bool = False,
+) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:
     """Logits [B, n_classes]. With ``mesh``, attention runs sequence-
     parallel over ``seq_axis`` in the flavor ``cfg.sp_attention`` selects
     ('ring': ppermute K/V rotation, any head count; 'ulysses': 2
     all_to_alls, needs n_heads % seq-axis size == 0); without a mesh, the
     dense reference. All flavors are numerically equivalent (pinned by
-    tests)."""
+    tests).
+
+    ``with_aux=True`` returns (logits, aux) where aux is the summed MoE
+    load-balance loss across layers (0.0 for the dense FFN) — loss_fn
+    uses it; plain callers keep the logits-only signature."""
     dt = cfg.dtype
     frames = batch["frames"].astype(dt)                    # [B, L, Din]
     lengths = batch["frames_len"]
@@ -126,6 +157,9 @@ def forward(
     h = cfg.n_heads
     dh = cfg.d_model // h
     x = _dense(params["embed"], frames, dt) + params["pos"][:l].astype(dt)[None]
+    # one validity mask for BOTH expert routing and the final pooling, so
+    # the two inertness contracts can never desynchronize
+    valid = jnp.arange(l)[None, :] < lengths[:, None]          # [B, L]
 
     def block(x, layer):
         qkv = _dense(layer["qkv"], _rms_norm(x), dt)        # [B, L, 3*D]
@@ -150,27 +184,41 @@ def forward(
         else:
             att = attention_reference(q, k, v, lengths=lengths)
         x = x + _dense(layer["proj"], att.reshape(b, l, cfg.d_model), dt)
+        if cfg.moe_experts > 0:
+            # padding positions are masked OUT of routing, capacity, and
+            # the aux loss — logits must depend only on valid content
+            # (same inertness contract as the attention mask)
+            y, aux = _moe.moe_apply(
+                layer["moe"], _rms_norm(x), _moe_cfg(cfg), valid=valid
+            )
+            return x + y, aux  # dropped tokens ride this residual
         y = _dense(layer["mlp_in"], _rms_norm(x), dt)
-        return x + _dense(layer["mlp_out"], jax.nn.gelu(y), dt)
+        return x + _dense(layer["mlp_out"], jax.nn.gelu(y), dt), jnp.float32(0.0)
 
     if cfg.remat:
         block = jax.checkpoint(block)
+    aux_total = jnp.float32(0.0)
     for layer in params["layers"]:
-        x = block(x, layer)
+        x, aux = block(x, layer)
+        aux_total = aux_total + aux
     # masked mean pool over the valid prefix
-    mask = (jnp.arange(l)[None, :] < lengths[:, None]).astype(jnp.float32)
+    mask = valid.astype(jnp.float32)
     pooled = (x.astype(jnp.float32) * mask[:, :, None]).sum(axis=1) / jnp.maximum(
         mask.sum(axis=1, keepdims=True), 1.0
     )
-    return _dense(params["head"], pooled.astype(dt), dt).astype(jnp.float32)
+    logits = _dense(params["head"], pooled.astype(dt), dt).astype(jnp.float32)
+    return (logits, aux_total) if with_aux else logits
 
 
 def loss_fn(params, batch, cfg: LongDocConfig, mesh=None, seq_axis="seq",
             data_axis=None) -> jax.Array:
-    logits = forward(params, batch, cfg, mesh, seq_axis, data_axis)
+    logits, aux = forward(
+        params, batch, cfg, mesh, seq_axis, data_axis, with_aux=True
+    )
     labels = batch["label"].astype(jnp.int32)
     logp = jax.nn.log_softmax(logits, axis=-1)
-    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+    ce = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+    return ce + cfg.moe_aux_weight * aux
 
 
 def train_step(params, opt_state, batch, cfg: LongDocConfig, tx, mesh=None,

@@ -84,10 +84,17 @@ def moe_apply(
     params: Dict[str, Any],
     x: jax.Array,
     cfg: MoEConfig,
+    valid: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Top-1 MoE FFN. x: [..., T, D] (leading dims flattened internally).
     Returns (y, aux_loss) with y.shape == x.shape; dropped tokens yield 0
     (add the residual outside). All shapes static — jits once.
+
+    ``valid``: optional boolean mask shaped like x without the feature dim
+    ([..., T]). Invalid (padding) tokens are excluded ENTIRELY: they get
+    zero output, consume no expert capacity (cannot displace later valid
+    tokens), and contribute nothing to the aux loss — so results depend
+    only on valid positions' content.
     """
     orig_shape = x.shape
     d = orig_shape[-1]
@@ -102,6 +109,15 @@ def moe_apply(
     gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]  # [T]
 
     onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)      # [T, E]
+    if valid is not None:
+        vt = valid.reshape(-1).astype(jnp.float32)             # [T]
+        onehot = onehot * vt[:, None]   # padding: no expert, no capacity
+        gate = gate * vt
+        n_tokens = jnp.maximum(vt.sum(), 1.0)
+        probs_for_aux = probs * vt[:, None]
+    else:
+        n_tokens = jnp.float32(t)
+        probs_for_aux = probs
     # position of each token within its expert's queue (0-based)
     pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0            # [T, E]
     kept = (pos < c) & (onehot > 0)                            # [T, E]
@@ -110,8 +126,9 @@ def moe_apply(
     combine = dispatch * gate[:, None, None]                   # [T, E, C]
 
     # load-balance aux loss (Switch eq. 4): E * mean(frac_tokens * mean_prob)
-    frac = onehot.mean(axis=0)                                 # [E]
-    mean_prob = probs.mean(axis=0)                              # [E]
+    # — means over VALID tokens only
+    frac = onehot.sum(axis=0) / n_tokens                       # [E]
+    mean_prob = probs_for_aux.sum(axis=0) / n_tokens           # [E]
     aux = (frac * mean_prob).sum() * e
 
     dt = cfg.dtype
@@ -122,13 +139,23 @@ def moe_apply(
     return y.reshape(orig_shape).astype(x.dtype), aux.astype(jnp.float32)
 
 
-def moe_reference(params: Dict[str, Any], x: jax.Array, cfg: MoEConfig) -> jax.Array:
+def moe_reference(
+    params: Dict[str, Any],
+    x: jax.Array,
+    cfg: MoEConfig,
+    valid: Optional[Any] = None,
+) -> jax.Array:
     """Per-token oracle: route each token to its argmax expert's FFN, gate
-    by the router prob, drop tokens beyond capacity in arrival order —
+    by the router prob, drop tokens beyond capacity in arrival order;
+    invalid tokens (``valid`` mask) are skipped entirely —
     definitionally what moe_apply's einsum dance computes."""
     import numpy as np
 
     xt = np.asarray(x, dtype=np.float64).reshape(-1, x.shape[-1])
+    vmask = (
+        np.asarray(valid).reshape(-1) if valid is not None
+        else np.ones(xt.shape[0], dtype=bool)
+    )
     router = np.asarray(params["router"], dtype=np.float64)
     w_in = np.asarray(params["w_in"], dtype=np.float64)
     w_out = np.asarray(params["w_out"], dtype=np.float64)
@@ -141,6 +168,8 @@ def moe_reference(params: Dict[str, Any], x: jax.Array, cfg: MoEConfig) -> jax.A
     counts = {ei: 0 for ei in range(cfg.n_experts)}
     out = np.zeros_like(xt)
     for i in range(t):
+        if not vmask[i]:
+            continue
         ei = int(expert[i])
         if counts[ei] >= cap:
             continue
