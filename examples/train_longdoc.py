@@ -117,6 +117,9 @@ def main() -> None:
         sp_attention=os.environ.get("LONGDOC_SP_ATTENTION", "ring"),
         # LONGDOC_MOE_EXPERTS=4 swaps the FFN for the Switch MoE layer
         moe_experts=int(os.environ.get("LONGDOC_MOE_EXPERTS", "0")),
+        # LONGDOC_KV_HEADS=2 runs GQA (k/v carry fewer heads; with
+        # sp_attention=ulysses it must divide the seq-axis size too)
+        n_kv_heads=int(os.environ.get("LONGDOC_KV_HEADS", "0")),
     )
     params = long_doc.init_params(jax.random.key(0), cfg)
     tx = optax.adam(1e-3)

@@ -352,3 +352,49 @@ class TestMoEFlavor:
         # the updated expert weights are still EP-partitioned, not gathered
         w = params["layers"][0]["moe"]["w_in"]
         assert w.addressable_shards[0].data.shape[0] == cfg.moe_experts // 2
+
+
+class TestGQAFlavor:
+    def test_gqa_mesh_matches_dense_reference(self):
+        """n_kv_heads < n_heads: the SP mesh path must equal the dense
+        reference on identical weights/batch (both flavors)."""
+        import dataclasses
+
+        # ring takes any Hkv (MQA Hkv=1 here); ulysses also needs
+        # Hkv % seq-axis == 0, so it runs GQA with Hkv=2 over 4 q heads
+        for flavor, heads, kv in (("ring", 2, 1), ("ulysses", 4, 2)):
+            cfg = dataclasses.replace(
+                CFG, n_heads=heads, n_kv_heads=kv, sp_attention=flavor
+            )
+            mesh = _mesh(data=2, seq=2)
+            params = long_doc.init_params(jax.random.key(0), cfg)
+            hb = long_doc.make_synthetic_batch(cfg, 8, seed=4)
+            batch = {k: jnp.asarray(v) for k, v in hb.items()}
+            want = long_doc.forward(params, batch, cfg)
+            sh = long_doc.batch_shardings(mesh, hb)
+            sharded = {k: jax.device_put(v, sh[k]) for k, v in batch.items()}
+            got = jax.jit(
+                functools.partial(
+                    long_doc.forward, cfg=cfg, mesh=mesh, data_axis="data"
+                )
+            )(params, sharded)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+            )
+
+    def test_kv_heads_shrink_qkv_projection(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(CFG, n_kv_heads=1)
+        params = long_doc.init_params(jax.random.key(0), cfg)
+        dh = cfg.d_model // cfg.n_heads
+        assert params["layers"][0]["qkv"]["w"].shape[-1] == (cfg.n_heads + 2) * dh
+
+    def test_indivisible_kv_heads_rejected(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(CFG, n_heads=2, n_kv_heads=0)  # fine
+        long_doc.init_params(jax.random.key(0), cfg)
+        bad = dataclasses.replace(CFG, n_heads=4, n_kv_heads=3)
+        with pytest.raises(ValueError, match="n_kv_heads"):
+            long_doc.init_params(jax.random.key(0), bad)

@@ -41,9 +41,25 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG = jnp.float32(-1e30)  # mask value; avoids inf-inf NaNs for empty rows
 
 
+def _expand_kv(q, kv):
+    """GQA: repeat K/V head groups to match q's head count (no-op for MHA).
+    q [B,L,H,D], kv [B,M,Hkv,D] with H % Hkv == 0 -> [B,M,H,D]."""
+    h, hkv = q.shape[2], kv.shape[2]
+    if h == hkv:
+        return kv
+    if h % hkv:
+        raise ValueError(
+            f"GQA needs num_heads % num_kv_heads == 0 (got H={h}, Hkv={hkv})"
+        )
+    return jnp.repeat(kv, h // hkv, axis=2)
+
+
 def attention_reference(q, k, v, lengths=None, scale: Optional[float] = None):
-    """Dense softmax attention oracle. q,k,v: [B, L, H, D] -> [B, L, H, D]."""
+    """Dense softmax attention oracle. q [B, L, H, D], k/v [B, L, Hkv, D]
+    with Hkv == H (MHA) or H % Hkv == 0 (GQA/MQA: each K/V head serves
+    H/Hkv query heads) -> [B, L, H, D]."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    k, v = _expand_kv(q, k), _expand_kv(q, v)
     scores = jnp.einsum("blhd,bmhd->bhlm", q, k).astype(jnp.float32) * scale
     if lengths is not None:
         valid = jnp.arange(k.shape[1])[None, :] < lengths[:, None]  # [B, M]
@@ -61,8 +77,13 @@ def _ring_attention_local(q, k, v, lengths, scale: float, axis_name: str):
     positions = jnp.arange(lc)
 
     def accumulate(step_i, k_blk, v_blk, m, l, o):
+        # GQA: the rotating blocks carry only Hkv heads (comm-optimal);
+        # repeat to H locally — XLA fuses the broadcast into the einsum
         scores = (
-            jnp.einsum("blhd,bmhd->bhlm", q, k_blk).astype(jnp.float32) * scale
+            jnp.einsum("blhd,bmhd->bhlm", q, _expand_kv(q, k_blk)).astype(
+                jnp.float32
+            )
+            * scale
         )  # [B, H, Lc, Lk]
         if lengths is not None:
             # the block arriving at ring step s originated on device
@@ -76,7 +97,9 @@ def _ring_attention_local(q, k, v, lengths, scale: float, axis_name: str):
         corr = jnp.exp(m - new_m)                             # rescale old sums
         probs = jnp.exp(scores - new_m[..., None])            # [B, H, Lc, Lk]
         l = l * corr + probs.sum(axis=-1)
-        upd = jnp.einsum("bhlm,bmhd->blhd", probs, v_blk.astype(jnp.float32))
+        upd = jnp.einsum(
+            "bhlm,bmhd->blhd", probs, _expand_kv(q, v_blk).astype(jnp.float32)
+        )
         o = o * corr.transpose(0, 2, 1)[..., None] + upd
         return new_m, l, o
 
@@ -142,7 +165,9 @@ def ring_attention(
 ) -> jax.Array:
     """Exact attention over a sequence sharded on ``mesh[seq_axis]``.
 
-    q,k,v: [B, L, H, D] with L divisible by the axis size. Pass
+    q: [B, L, H, D]; k,v: [B, L, Hkv, D] with Hkv == H (MHA) or any
+    positive divisor of H (GQA/MQA — only the Hkv heads rotate the ring,
+    the group repeat fuses locally). L divisible by the axis size. Pass
     ``data_axis`` to keep the batch dim sharded. ``lengths`` [B] masks
     padded key positions (the ingest layer's ``<name>_len`` output).
     """
@@ -182,18 +207,21 @@ def ulysses_attention(
     :func:`ring_attention`, different collective/memory profile (see module
     docstring for when to pick which).
 
-    q,k,v: [B, L, H, D] with L divisible by the axis size and H divisible by
-    the axis size (each device owns a head group while attending over the
-    full sequence). ``lengths`` [B] masks padded key positions.
+    q: [B, L, H, D]; k,v: [B, L, Hkv, D] (GQA: Hkv a positive divisor of
+    H). L, H, AND Hkv must all be divisible by the axis size — each device
+    owns a head group while attending over the full sequence, so MQA
+    (Hkv=1) on a >1 axis is ring-only. ``lengths`` [B] masks padded key
+    positions.
     """
     p = mesh.shape[seq_axis]
-    h = q.shape[2]
-    if h % p:
+    h, hkv = q.shape[2], k.shape[2]
+    if h % p or hkv % p:
         raise ValueError(
             f"ulysses_attention needs num_heads % mesh['{seq_axis}'] == 0 "
-            f"(got H={h}, axis size {p}); use ring_attention when heads "
-            f"cannot cover the sequence axis"
+            f"for q AND k/v (got H={h}, Hkv={hkv}, axis size {p}); use "
+            f"ring_attention when heads cannot cover the sequence axis"
         )
+    # H % Hkv is guarded once, in _expand_kv (shared with the ring flavor)
     return _shard_map_attention(
         _ulysses_attention_local, q, k, v, mesh, seq_axis, data_axis, lengths, scale
     )

@@ -42,6 +42,14 @@ class LongDocConfig:
     seq_dim: int = 16        # input frame feature dim (ingest output)
     d_model: int = 32
     n_heads: int = 4
+    # 0 = MHA (n_kv_heads == n_heads). Set lower for GQA/MQA: k/v carry
+    # only this many heads — smaller qkv projection AND smaller K/V blocks
+    # on the SP collectives (ring rotations / ulysses exchanges move Hkv,
+    # not H) — each serving n_heads/n_kv_heads query heads. NOTE the
+    # ulysses flavor additionally needs n_kv_heads % seq-axis size == 0
+    # (it splits kv heads across the axis), so MQA (1 kv head) on a >1
+    # seq axis is ring-only.
+    n_kv_heads: int = 0
     n_layers: int = 2
     mlp_mult: int = 4
     n_classes: int = 2
@@ -81,6 +89,12 @@ def init_params(rng: jax.Array, cfg: LongDocConfig) -> Dict[str, Any]:
         raise ValueError(
             f"sp_attention must be 'ring' or 'ulysses', got {cfg.sp_attention!r}"
         )
+    hkv = cfg.n_kv_heads or cfg.n_heads
+    if hkv <= 0 or cfg.n_heads % hkv:
+        raise ValueError(
+            f"n_kv_heads must be a positive divisor of n_heads "
+            f"({cfg.n_heads}); got {cfg.n_kv_heads}"
+        )
     keys = jax.random.split(rng, 3 + cfg.n_layers)
     params: Dict[str, Any] = {
         "embed": _dense_init(keys[0], cfg.seq_dim, cfg.d_model),
@@ -92,8 +106,12 @@ def init_params(rng: jax.Array, cfg: LongDocConfig) -> Dict[str, Any]:
     layers = []
     for i in range(cfg.n_layers):
         k = jax.random.split(keys[3 + i], 4)
+        dh = cfg.d_model // cfg.n_heads
         layer = {
-            "qkv": _dense_init(k[0], cfg.d_model, 3 * cfg.d_model),
+            # q gets H heads, k and v get Hkv each (== 3*d_model for MHA)
+            "qkv": _dense_init(
+                k[0], cfg.d_model, (cfg.n_heads + 2 * hkv) * dh
+            ),
             "proj": _dense_init(k[1], cfg.d_model, cfg.d_model),
         }
         if cfg.moe_experts > 0:
@@ -155,6 +173,7 @@ def forward(
     lengths = batch["frames_len"]
     b, l, _ = frames.shape
     h = cfg.n_heads
+    hkv = cfg.n_kv_heads or h
     dh = cfg.d_model // h
     x = _dense(params["embed"], frames, dt) + params["pos"][:l].astype(dt)[None]
     # one validity mask for BOTH expert routing and the final pooling, so
@@ -162,11 +181,13 @@ def forward(
     valid = jnp.arange(l)[None, :] < lengths[:, None]          # [B, L]
 
     def block(x, layer):
-        qkv = _dense(layer["qkv"], _rms_norm(x), dt)        # [B, L, 3*D]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        qkv = _dense(layer["qkv"], _rms_norm(x), dt)   # [B, L, (H+2*Hkv)*dh]
+        q, k, v = jnp.split(
+            qkv, [h * dh, (h + hkv) * dh], axis=-1
+        )
         q = q.reshape(b, l, h, dh)
-        k = k.reshape(b, l, h, dh)
-        v = v.reshape(b, l, h, dh)
+        k = k.reshape(b, l, hkv, dh)
+        v = v.reshape(b, l, hkv, dh)
         if mesh is not None:
             if cfg.sp_attention == "ulysses":
                 sp = ulysses_attention
