@@ -254,3 +254,72 @@ class TestGQA:
         q, k, v = self.make_gqa(h=4, hkv=3, l=16)
         with pytest.raises(ValueError, match="num_kv_heads"):
             ring_attention(q, k, v, mesh)
+
+
+class TestCausal:
+    """Decoder/LM masking: keys after the query position get no mass.
+    The ring must mask by GLOBAL positions across rotated blocks; ulysses
+    inherits the mask locally after the exchange."""
+
+    def test_ring_causal_matches_oracle(self):
+        mesh = create_mesh({"seq": 8})
+        q, k, v = make_qkv()
+        want = attention_reference(q, k, v, causal=True)
+        got = jax.jit(
+            lambda q, k, v: ring_attention(q, k, v, mesh, causal=True)
+        )(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6)
+
+    def test_ulysses_causal_matches_oracle(self):
+        from tpu_tfrecord.models.attention import ulysses_attention
+
+        mesh = create_mesh({"seq": 4, "data": 2})
+        q, k, v = make_qkv(l=16, h=4)
+        want = attention_reference(q, k, v, causal=True)
+        got = jax.jit(
+            lambda q, k, v: ulysses_attention(q, k, v, mesh, causal=True)
+        )(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6)
+
+    def test_causal_composes_with_lengths_and_gqa(self):
+        mesh = create_mesh({"seq": 4}, jax.devices()[:4])
+        q = jnp.asarray(np.random.default_rng(0).normal(size=(3, 16, 4, 8)), jnp.float32)
+        kv = [jnp.asarray(np.random.default_rng(i).normal(size=(3, 16, 2, 8)), jnp.float32) for i in (1, 2)]
+        lengths = jnp.asarray([16, 7, 2], dtype=jnp.int32)
+        g = 2
+        want = attention_reference(
+            q, jnp.repeat(kv[0], g, axis=2), jnp.repeat(kv[1], g, axis=2),
+            lengths=lengths, causal=True,
+        )
+        got = jax.jit(
+            lambda q, k, v, le: ring_attention(q, k, v, mesh, lengths=le, causal=True)
+        )(q, kv[0], kv[1], lengths)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6)
+
+    def test_future_keys_are_inert(self):
+        """Garbage in strictly-future K/V positions must not change any
+        query's output (the operational meaning of causal)."""
+        mesh = create_mesh({"seq": 4}, jax.devices()[:4])
+        q, k, v = make_qkv(b=1, l=16)
+        base = ring_attention(q, k, v, mesh, causal=True)
+        # poison the second half; queries in the FIRST half must not move
+        k2 = k.at[:, 8:].set(777.0)
+        v2 = v.at[:, 8:].set(-777.0)
+        got = ring_attention(q, k2, v2, mesh, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(got)[:, :8], np.asarray(base)[:, :8], rtol=1e-6
+        )
+
+    def test_causal_grads_match_oracle(self):
+        mesh = create_mesh({"seq": 8})
+        q, k, v = make_qkv(l=16)
+        g = jax.jit(
+            jax.grad(lambda q, k, v: ring_attention(q, k, v, mesh, causal=True).sum(),
+                     argnums=(0, 1, 2))
+        )(q, k, v)
+        g_ref = jax.grad(
+            lambda q, k, v: attention_reference(q, k, v, causal=True).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(g, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)

@@ -437,3 +437,42 @@ class TestNativeInferOracle:
                 wire.read_records(sh.path), RecordType.EXAMPLE, limit=limit
             )
             assert native == oracle, limit
+
+
+class TestSpanStreamFuzz:
+    def test_slab_and_limit_sweep_matches_oracle(self, tmp_path, monkeypatch):
+        """scan_spans_stream must yield the identical record sequence for
+        EVERY (slab size, max_records, leg) combination — tiny slabs force
+        partial-frame tail carries to interact with the record limit, the
+        newest shared seam between the dataset and inference paths."""
+        import random
+
+        from tpu_tfrecord import _native, wire
+        from tpu_tfrecord.io.reader import scan_spans_stream
+
+        rng = random.Random(11)
+        payloads = [
+            bytes(rng.randrange(256) for _ in range(rng.choice([0, 1, 7, 40, 300])))
+            for _ in range(57)
+        ]
+        path = tmp_path / "fuzz.tfrecord"
+        wire.write_records(str(path), payloads)
+
+        def collect(slab, limit):
+            got = []
+            for buf, offs, lens in scan_spans_stream(
+                str(path), True, slab_bytes=slab, max_records=limit
+            ):
+                got.extend(
+                    bytes(buf[int(o) : int(o) + int(l)])
+                    for o, l in zip(offs, lens)
+                )
+            return got
+
+        legs = [True, False] if _native.available() else [False]
+        for native_on in legs:
+            monkeypatch.setattr(_native, "available", lambda v=native_on: v)
+            for slab in (17, 64, 333, 1 << 20):
+                for limit in (None, 0, 1, 5, 56, 57, 500):
+                    want = payloads if limit is None else payloads[:limit]
+                    assert collect(slab, limit) == want, (native_on, slab, limit)

@@ -25,8 +25,19 @@ pattern — pick by sequence length and head count:
 
 Both accept `lengths` to mask padded key positions — the `<name>_len`
 arrays the ingest layer emits plug in directly, so pad tokens never receive
-softmax mass. `attention_reference` is the plain dense oracle used by the
-tests.
+softmax mass — and `causal=True` for decoder/LM masking (the ring masks by
+GLOBAL key position across rotated blocks; ulysses applies the standard
+triangle locally after the exchange, where each device holds the full
+sequence).
+
+Known limitation (efficiency, not correctness): the causal ring keeps the
+contiguous block layout, so fully-future blocks are computed then masked —
+~2x the necessary FLOPs, and the last ring device sets the wall-clock.
+The standard fix is zigzag/striped block assignment (each device owns
+strips i and 2p-1-i), which balances useful work but re-striped the global
+sequence layout — a follow-up that changes the input contract, so it is
+deliberately not bundled into this flag. `attention_reference` is the
+plain dense oracle used by the tests.
 """
 
 from __future__ import annotations
@@ -54,21 +65,30 @@ def _expand_kv(q, kv):
     return jnp.repeat(kv, h // hkv, axis=2)
 
 
-def attention_reference(q, k, v, lengths=None, scale: Optional[float] = None):
+def attention_reference(
+    q, k, v, lengths=None, scale: Optional[float] = None, causal: bool = False
+):
     """Dense softmax attention oracle. q [B, L, H, D], k/v [B, L, Hkv, D]
     with Hkv == H (MHA) or H % Hkv == 0 (GQA/MQA: each K/V head serves
-    H/Hkv query heads) -> [B, L, H, D]."""
+    H/Hkv query heads) -> [B, L, H, D]. ``causal`` masks keys after each
+    query position (decoder/LM attention)."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     k, v = _expand_kv(q, k), _expand_kv(q, v)
     scores = jnp.einsum("blhd,bmhd->bhlm", q, k).astype(jnp.float32) * scale
     if lengths is not None:
         valid = jnp.arange(k.shape[1])[None, :] < lengths[:, None]  # [B, M]
         scores = jnp.where(valid[:, None, None, :], scores, _NEG)
+    if causal:
+        l, m = q.shape[1], k.shape[1]
+        tri = jnp.arange(m)[None, :] <= jnp.arange(l)[:, None]    # [L, M]
+        scores = jnp.where(tri[None, None, :, :], scores, _NEG)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhlm,bmhd->blhd", probs, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _ring_attention_local(q, k, v, lengths, scale: float, axis_name: str):
+def _ring_attention_local(
+    q, k, v, lengths, scale: float, axis_name: str, causal: bool = False
+):
     """Per-device body (inside shard_map): q,k,v are the local sequence
     chunks [B, Lc, H, D]; K/V rotate one neighbor per step."""
     p = jax.lax.axis_size(axis_name)
@@ -85,13 +105,21 @@ def _ring_attention_local(q, k, v, lengths, scale: float, axis_name: str):
             )
             * scale
         )  # [B, H, Lc, Lk]
+        # the block arriving at ring step s originated on device
+        # (idx - s) mod p: its keys cover global positions src*Lc + j
+        src = jax.lax.rem(idx - step_i + p, p)
+        key_pos = src * lc + positions                        # [Lk]
         if lengths is not None:
-            # the block arriving at ring step s originated on device
-            # (idx - s) mod p: its keys cover global positions src*Lc + j
-            src = jax.lax.rem(idx - step_i + p, p)
-            key_pos = src * lc + positions                    # [Lk]
             valid = key_pos[None, :] < lengths[:, None]       # [B, Lk]
             scores = jnp.where(valid[:, None, None, :], scores, _NEG)
+        if causal:
+            # mask by GLOBAL positions: this device's queries sit at
+            # idx*Lc + i; a fully-future block masks to _NEG everywhere
+            # and contributes ~0 mass (the m0=-1e30 floor keeps the
+            # online softmax finite)
+            q_pos = idx * lc + positions                      # [Lq]
+            tri = key_pos[None, :] <= q_pos[:, None]          # [Lq, Lk]
+            scores = jnp.where(tri[None, None, :, :], scores, _NEG)
         blk_max = scores.max(axis=-1)                         # [B, H, Lc]
         new_m = jnp.maximum(m, blk_max)
         corr = jnp.exp(m - new_m)                             # rescale old sums
@@ -127,7 +155,9 @@ def _ring_attention_local(q, k, v, lengths, scale: float, axis_name: str):
     return out.astype(q.dtype)
 
 
-def _shard_map_attention(local_fn, q, k, v, mesh, seq_axis, data_axis, lengths, scale):
+def _shard_map_attention(
+    local_fn, q, k, v, mesh, seq_axis, data_axis, lengths, scale, causal=False
+):
     """Shared dispatch for both SP flavors: one shard_map over the sequence
     axis (batch optionally on ``data_axis`` — an unsharded spec on a sharded
     batch would silently gather it to every device), ``lengths`` riding
@@ -137,7 +167,8 @@ def _shard_map_attention(local_fn, q, k, v, mesh, seq_axis, data_axis, lengths, 
     if lengths is None:
         fn = jax.shard_map(
             functools.partial(
-                local_fn, lengths=None, scale=scale, axis_name=seq_axis
+                local_fn, lengths=None, scale=scale, axis_name=seq_axis,
+                causal=causal,
             ),
             mesh=mesh,
             in_specs=(spec, spec, spec),
@@ -145,7 +176,9 @@ def _shard_map_attention(local_fn, q, k, v, mesh, seq_axis, data_axis, lengths, 
         )
         return fn(q, k, v)
     fn = jax.shard_map(
-        functools.partial(local_fn, scale=scale, axis_name=seq_axis),
+        functools.partial(
+            local_fn, scale=scale, axis_name=seq_axis, causal=causal
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec, P(data_axis)),
         out_specs=spec,
@@ -162,6 +195,7 @@ def ring_attention(
     data_axis: Optional[str] = None,
     lengths: Optional[jax.Array] = None,
     scale: Optional[float] = None,
+    causal: bool = False,
 ) -> jax.Array:
     """Exact attention over a sequence sharded on ``mesh[seq_axis]``.
 
@@ -172,11 +206,14 @@ def ring_attention(
     padded key positions (the ingest layer's ``<name>_len`` output).
     """
     return _shard_map_attention(
-        _ring_attention_local, q, k, v, mesh, seq_axis, data_axis, lengths, scale
+        _ring_attention_local, q, k, v, mesh, seq_axis, data_axis, lengths,
+        scale, causal,
     )
 
 
-def _ulysses_attention_local(q, k, v, lengths, scale: float, axis_name: str):
+def _ulysses_attention_local(
+    q, k, v, lengths, scale: float, axis_name: str, causal: bool = False
+):
     """Per-device body (inside shard_map): q,k,v are the local sequence
     chunks [B, Lc, H, D]. Two all-to-alls re-shard sequence<->heads; the
     attention itself is plain dense math over the full sequence for this
@@ -187,7 +224,9 @@ def _ulysses_attention_local(q, k, v, lengths, scale: float, axis_name: str):
         jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
         for x in (q, k, v)
     )
-    out = attention_reference(qh, kh, vh, lengths=lengths, scale=scale)
+    # post-exchange each device holds the FULL sequence for its head
+    # group, so the dense oracle's local causal mask IS the global one
+    out = attention_reference(qh, kh, vh, lengths=lengths, scale=scale, causal=causal)
     # inverse exchange: [B, L, H/p, D] -> [B, Lc, H, D]
     return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
@@ -201,6 +240,7 @@ def ulysses_attention(
     data_axis: Optional[str] = None,
     lengths: Optional[jax.Array] = None,
     scale: Optional[float] = None,
+    causal: bool = False,
 ) -> jax.Array:
     """Exact attention over a sequence sharded on ``mesh[seq_axis]`` via the
     all-to-all (DeepSpeed-Ulysses) pattern — same contract and results as
@@ -223,5 +263,6 @@ def ulysses_attention(
         )
     # H % Hkv is guarded once, in _expand_kv (shared with the ring flavor)
     return _shard_map_attention(
-        _ulysses_attention_local, q, k, v, mesh, seq_axis, data_axis, lengths, scale
+        _ulysses_attention_local, q, k, v, mesh, seq_axis, data_axis, lengths,
+        scale, causal,
     )
