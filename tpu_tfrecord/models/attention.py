@@ -30,14 +30,14 @@ GLOBAL key position across rotated blocks; ulysses applies the standard
 triangle locally after the exchange, where each device holds the full
 sequence).
 
-Known limitation (efficiency, not correctness): the causal ring keeps the
-contiguous block layout, so fully-future blocks are computed then masked —
-~2x the necessary FLOPs, and the last ring device sets the wall-clock.
-The standard fix is zigzag/striped block assignment (each device owns
-strips i and 2p-1-i), which balances useful work but re-striped the global
-sequence layout — a follow-up that changes the input contract, so it is
-deliberately not bundled into this flag. `attention_reference` is the
-plain dense oracle used by the tests.
+The causal ring has two layouts: the default contiguous one computes-
+then-masks future blocks (device 0 ends with 1 useful block, device p-1
+with p — the last device sets wall-clock), while ``zigzag=True`` re-
+stripes internally (device i owns strip 2i AND its mirror 2p-1-2i) so every
+device holds the same number of unmasked (q, k) pairs — the standard
+balanced causal ring schedule — at the cost of one O(L*H*D) permute each
+way; callers keep the contiguous contract on both sides.
+`attention_reference` is the plain dense oracle used by the tests.
 """
 
 from __future__ import annotations
@@ -87,14 +87,59 @@ def attention_reference(
 
 
 def _ring_attention_local(
-    q, k, v, lengths, scale: float, axis_name: str, causal: bool = False
+    q, k, v, lengths, scale: float, axis_name: str, causal: bool = False,
+    zigzag: bool = False,
 ):
     """Per-device body (inside shard_map): q,k,v are the local sequence
-    chunks [B, Lc, H, D]; K/V rotate one neighbor per step."""
+    chunks [B, Lc, H, D]; K/V rotate one neighbor per step.
+
+    ``zigzag`` (causal only): the balanced causal-ring schedule. One
+    ppermute involution swaps second chunk-halves between device j and
+    p-1-j, so device j owns strip 2j AND its mirror 2p-1-2j (strip size
+    Lc/2). Every (device, step) then needs exactly HALF the score matrix
+    — either one k-half against all q rows or all keys against one
+    q-half, both strictly unmasked by construction — computed via
+    lax.cond'd half-block einsums (the diagonal step keeps the full
+    masked block). Work is balanced per step AND per device, at half the
+    dense FLOPs; the output swaps back before return, so callers keep the
+    contiguous [B, L, ...] contract end to end."""
     p = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
+    if zigzag:
+        swap = [(j, p - 1 - j) for j in range(p)]
+        half = q.shape[1] // 2
+
+        def restripe(x):
+            other = jax.lax.ppermute(x[:, half:], axis_name, swap)
+            return jnp.concatenate([x[:, :half], other], axis=1)
+
+        q, k, v = restripe(q), restripe(k), restripe(v)
     b, lc, h, d = q.shape
     positions = jnp.arange(lc)
+
+    def dev_pos(dev):
+        """Global positions of device ``dev``'s local rows."""
+        if zigzag:
+            s = lc // 2
+            half_ar = jnp.arange(s)
+            return jnp.concatenate(
+                [2 * dev * s + half_ar, (2 * p - 1 - 2 * dev) * s + half_ar]
+            )
+        return dev * lc + positions
+
+    def online_update(scores, v_rows, m, l, o):
+        """One online-softmax fold of ``scores`` [B,H,R,K] with values
+        ``v_rows`` [B,K,H,D] into accumulators covering the same R rows —
+        the ONE implementation every path (full, half-k, half-q) folds
+        through."""
+        blk_max = scores.max(axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        corr = jnp.exp(m - new_m)                             # rescale old sums
+        probs = jnp.exp(scores - new_m[..., None])
+        l = l * corr + probs.sum(axis=-1)
+        upd = jnp.einsum("bhlm,bmhd->blhd", probs, v_rows.astype(jnp.float32))
+        o = o * corr.transpose(0, 2, 1)[..., None] + upd
+        return new_m, l, o
 
     def accumulate(step_i, k_blk, v_blk, m, l, o):
         # GQA: the rotating blocks carry only Hkv heads (comm-optimal);
@@ -106,30 +151,82 @@ def _ring_attention_local(
             * scale
         )  # [B, H, Lc, Lk]
         # the block arriving at ring step s originated on device
-        # (idx - s) mod p: its keys cover global positions src*Lc + j
+        # (idx - s) mod p: its keys cover that device's global positions
         src = jax.lax.rem(idx - step_i + p, p)
-        key_pos = src * lc + positions                        # [Lk]
+        key_pos = dev_pos(src)                                # [Lk]
         if lengths is not None:
             valid = key_pos[None, :] < lengths[:, None]       # [B, Lk]
             scores = jnp.where(valid[:, None, None, :], scores, _NEG)
         if causal:
-            # mask by GLOBAL positions: this device's queries sit at
-            # idx*Lc + i; a fully-future block masks to _NEG everywhere
-            # and contributes ~0 mass (the m0=-1e30 floor keeps the
-            # online softmax finite)
-            q_pos = idx * lc + positions                      # [Lq]
+            # mask by GLOBAL positions; a fully-future block masks to _NEG
+            # everywhere and contributes ~0 mass (the m0=-1e30 floor keeps
+            # the online softmax finite)
+            q_pos = dev_pos(idx)                              # [Lq]
             tri = key_pos[None, :] <= q_pos[:, None]          # [Lq, Lk]
             scores = jnp.where(tri[None, None, :, :], scores, _NEG)
-        blk_max = scores.max(axis=-1)                         # [B, H, Lc]
-        new_m = jnp.maximum(m, blk_max)
-        corr = jnp.exp(m - new_m)                             # rescale old sums
-        probs = jnp.exp(scores - new_m[..., None])            # [B, H, Lc, Lk]
-        l = l * corr + probs.sum(axis=-1)
-        upd = jnp.einsum(
-            "bhlm,bmhd->blhd", probs, _expand_kv(q, v_blk).astype(jnp.float32)
+        return online_update(scores, _expand_kv(q, v_blk), m, l, o)
+
+    def accumulate_zigzag(step_i, k_blk, v_blk, m, l, o):
+        """Balanced causal step for NON-diagonal blocks (step_i >= 1; step
+        0 is the device's own block — the causal diagonal — folded once
+        through ``accumulate`` before the loop): exactly HALF the score
+        matrix is needed and that half is strictly unmasked by strip
+        construction, so only it is computed."""
+        s = lc // 2
+        src = jax.lax.rem(idx - step_i + p, p)
+        key_pos = dev_pos(src)
+
+        def len_mask(scores, kp):
+            if lengths is None:
+                return scores
+            valid = kp[None, :] < lengths[:, None]
+            return jnp.where(valid[:, None, None, :], scores, _NEG)
+
+        # both half-starts share the same selector: the EARLY half when the
+        # block comes from a lower rank, the LATE half otherwise
+        start = jnp.where(src < idx, 0, s)
+
+        def half_k(m, l, o):
+            # one k-half against ALL q rows (strictly unmasked quadrants)
+            kh = jax.lax.dynamic_slice_in_dim(k_blk, start, s, axis=1)
+            vh = jax.lax.dynamic_slice_in_dim(v_blk, start, s, axis=1)
+            kp = jax.lax.dynamic_slice_in_dim(key_pos, start, s, axis=0)
+            scores = (
+                jnp.einsum("blhd,bmhd->bhlm", q, _expand_kv(q, kh)).astype(
+                    jnp.float32
+                )
+                * scale
+            )
+            return online_update(len_mask(scores, kp), _expand_kv(q, vh), m, l, o)
+
+        def half_q(m, l, o):
+            # all keys against ONE q-half: fold into that half's slice of
+            # the accumulators only
+            qh = jax.lax.dynamic_slice_in_dim(q, start, s, axis=1)
+            scores = (
+                jnp.einsum("blhd,bmhd->bhlm", qh, _expand_kv(q, k_blk)).astype(
+                    jnp.float32
+                )
+                * scale
+            )
+            scores = len_mask(scores, key_pos)
+            ms = jax.lax.dynamic_slice_in_dim(m, start, s, axis=2)
+            ls = jax.lax.dynamic_slice_in_dim(l, start, s, axis=2)
+            os_ = jax.lax.dynamic_slice_in_dim(o, start, s, axis=1)
+            ms, ls, os_ = online_update(scores, _expand_kv(q, v_blk), ms, ls, os_)
+            return (
+                jax.lax.dynamic_update_slice_in_dim(m, ms, start, axis=2),
+                jax.lax.dynamic_update_slice_in_dim(l, ls, start, axis=2),
+                jax.lax.dynamic_update_slice_in_dim(o, os_, start, axis=1),
+            )
+
+        # half-k when (src < idx) agrees with (src + idx <= p - 1); the
+        # complementary off-diagonal cases are half-q (derivation in the
+        # PARITY zigzag note)
+        pred_a = (src < idx) == (src + idx <= p - 1)
+        return jax.lax.cond(
+            pred_a, lambda t: half_k(*t), lambda t: half_q(*t), (m, l, o)
         )
-        o = o * corr.transpose(0, 2, 1)[..., None] + upd
-        return new_m, l, o
 
     # Accumulators are per-device state: derive them from q so they carry
     # exactly q's varying axes (seq, and data when the batch is sharded) —
@@ -139,24 +236,37 @@ def _ring_attention_local(
     l0 = zero_bhl
     o0 = q.astype(jnp.float32) * 0.0
     perm = [(j, (j + 1) % p) for j in range(p)]
+    # Step 0 is always the device's OWN block — the causal diagonal — so
+    # the full masked fold happens exactly once, hoisted out of the loop;
+    # the loop body then carries only the half-block program under zigzag.
+    m, l, o = accumulate(0, k, v, m0, l0, o0)
+    if p > 1:
+        rest = accumulate_zigzag if (zigzag and causal) else accumulate
+        # rotate K/V one neighbor around the ring (ICI hop); p-1 hops in
+        # total — the final block needs no outgoing hop
+        k_blk = jax.lax.ppermute(k, axis_name, perm)
+        v_blk = jax.lax.ppermute(v, axis_name, perm)
 
-    def step(i, carry):
-        k_blk, v_blk, m, l, o = carry
-        m, l, o = accumulate(i, k_blk, v_blk, m, l, o)
-        # rotate K/V one neighbor around the ring (ICI hop); runs only for
-        # the first p-1 blocks — the last block needs no outgoing hop
-        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        return k_blk, v_blk, m, l, o
+        def step(i, carry):
+            k_blk, v_blk, m, l, o = carry
+            m, l, o = rest(i, k_blk, v_blk, m, l, o)
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            return k_blk, v_blk, m, l, o
 
-    k_blk, v_blk, m, l, o = jax.lax.fori_loop(0, p - 1, step, (k, v, m0, l0, o0))
-    _, l, o = accumulate(p - 1, k_blk, v_blk, m, l, o)
+        k_blk, v_blk, m, l, o = jax.lax.fori_loop(
+            1, p - 1, step, (k_blk, v_blk, m, l, o)
+        )
+        m, l, o = rest(p - 1, k_blk, v_blk, m, l, o)
     out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    if zigzag:
+        out = restripe(out)  # the half-swap is an involution: swap back
     return out.astype(q.dtype)
 
 
 def _shard_map_attention(
-    local_fn, q, k, v, mesh, seq_axis, data_axis, lengths, scale, causal=False
+    local_fn, q, k, v, mesh, seq_axis, data_axis, lengths, scale,
+    causal=False, **local_kwargs,
 ):
     """Shared dispatch for both SP flavors: one shard_map over the sequence
     axis (batch optionally on ``data_axis`` — an unsharded spec on a sharded
@@ -168,7 +278,7 @@ def _shard_map_attention(
         fn = jax.shard_map(
             functools.partial(
                 local_fn, lengths=None, scale=scale, axis_name=seq_axis,
-                causal=causal,
+                causal=causal, **local_kwargs,
             ),
             mesh=mesh,
             in_specs=(spec, spec, spec),
@@ -177,7 +287,8 @@ def _shard_map_attention(
         return fn(q, k, v)
     fn = jax.shard_map(
         functools.partial(
-            local_fn, scale=scale, axis_name=seq_axis, causal=causal
+            local_fn, scale=scale, axis_name=seq_axis, causal=causal,
+            **local_kwargs,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec, P(data_axis)),
@@ -196,6 +307,7 @@ def ring_attention(
     lengths: Optional[jax.Array] = None,
     scale: Optional[float] = None,
     causal: bool = False,
+    zigzag: bool = False,
 ) -> jax.Array:
     """Exact attention over a sequence sharded on ``mesh[seq_axis]``.
 
@@ -204,10 +316,33 @@ def ring_attention(
     the group repeat fuses locally). L divisible by the axis size. Pass
     ``data_axis`` to keep the batch dim sharded. ``lengths`` [B] masks
     padded key positions (the ingest layer's ``<name>_len`` output).
+
+    ``zigzag`` (causal only): the balanced causal-ring schedule. One
+    ppermute involution inside the kernel swaps second chunk-halves
+    between device j and p-1-j, giving each device one early strip and
+    its mirror; every non-diagonal ring step then computes only the half
+    of the score matrix that is unmasked by construction (lax.cond'd
+    half-block einsums) — HALF the dense causal FLOPs, balanced per step
+    and per device — and the output swaps back, so callers keep the
+    contiguous [B, L, ...] contract on both sides. Needs
+    L % (2 * axis size) == 0. The swap moves O(L*H*D/p) bytes per device
+    each way vs the O(L^2) attention it balances.
     """
+    if zigzag:
+        if not causal:
+            raise ValueError(
+                "zigzag re-striping only changes anything for causal "
+                "attention; pass causal=True or drop zigzag"
+            )
+        if q.shape[1] % (2 * mesh.shape[seq_axis]):
+            raise ValueError(
+                f"zigzag needs sequence length % (2 * mesh['{seq_axis}']) "
+                f"== 0 (got L={q.shape[1]}, axis size "
+                f"{mesh.shape[seq_axis]})"
+            )
     return _shard_map_attention(
         _ring_attention_local, q, k, v, mesh, seq_axis, data_axis, lengths,
-        scale, causal,
+        scale, causal, zigzag=zigzag,
     )
 
 
