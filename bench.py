@@ -12,7 +12,10 @@ vs_baseline is value / 1e6 (the reference publishes no numbers —
 BASELINE.md: >=1M examples/sec/host target; >1.0 beats it).
 
 Dataset: Criteo-shaped — int64 label, 13 int64 dense features, 26
-categorical byte strings — 16 shards, generated once and cached.
+categorical byte strings — TFR_BENCH_SHARDS shards (default 4) of
+RECORDS_PER_SHARD records, generated once and cached (the cache key
+includes the shard count, so changing TFR_BENCH_SHARDS regenerates
+instead of silently benchmarking a stale dataset).
 """
 
 from __future__ import annotations
@@ -26,8 +29,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
-N_SHARDS = 4
-RECORDS_PER_SHARD = 32768
+N_SHARDS = int(os.environ.get("TFR_BENCH_SHARDS", 4))
+RECORDS_PER_SHARD = int(os.environ.get("TFR_BENCH_RECORDS_PER_SHARD", 32768))
 BATCH_SIZE = int(os.environ.get("TFR_BENCH_BATCH", 16384))
 HASH_BUCKETS = 1 << 20
 CAT_BITS = 20  # hash_buckets = 2**20 -> bucket indices carry 20 bits
@@ -68,11 +71,15 @@ def criteo_read_schema():
 
 
 def ensure_dataset(data_dir: str) -> str:
-    """Generate the benchmark dataset once; reuse across runs."""
+    """Generate the benchmark dataset once; reuse across runs. The cache
+    key (a subdirectory) includes the generation parameters, so changing
+    TFR_BENCH_SHARDS / TFR_BENCH_RECORDS_PER_SHARD regenerates instead of
+    silently measuring a stale dataset of the wrong shape."""
     from tpu_tfrecord import wire
     from tpu_tfrecord.options import RecordType
     from tpu_tfrecord.serde import TFRecordSerializer, encode_row
 
+    data_dir = os.path.join(data_dir, f"s{N_SHARDS}r{RECORDS_PER_SHARD}")
     marker = os.path.join(data_dir, "_BENCH_READY")
     if os.path.exists(marker):
         return data_dir
@@ -524,7 +531,7 @@ def main() -> None:
     from tpu_tfrecord.tracing import DutyCycle
 
     data_dir = os.environ.get("TFR_BENCH_DIR", "/tmp/tpu_tfrecord_bench_v2")
-    ensure_dataset(data_dir)
+    data_dir = ensure_dataset(data_dir)
     schema = criteo_read_schema()
     hash_buckets = {f"C{i}": HASH_BUCKETS for i in range(1, 27)}
 

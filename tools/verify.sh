@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# One-entry-point verification: the fast syntax gate plus the tier-1 test
+# command from ROADMAP.md (keep the pytest invocation in sync with it).
+# Usage: tools/verify.sh  (from the repo root or anywhere)
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== syntax gate (compileall) =="
+python -m compileall -q tpu_tfrecord || exit 1
+
+echo "== tier-1 tests =="
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

@@ -437,6 +437,18 @@ struct string_hash {
 
 using FieldMap = std::unordered_map<std::string, int, string_hash, std::equal_to<>>;
 
+// Heterogeneous unordered lookup (P0919) landed in libstdc++ 11; on older
+// toolchains (GCC 10 ships with this image's Debian) fall back to a
+// temporary std::string. The StickyOrder fast path keeps the hash lookup
+// rare, so the fallback allocation is off the hot path.
+inline FieldMap::const_iterator field_find(const FieldMap& m, std::string_view key) {
+#if defined(__cpp_lib_generic_unordered_lookup)
+  return m.find(key);
+#else
+  return m.find(std::string(key));
+#endif
+}
+
 // Records from one writer almost always carry their feature-map entries in
 // the same key order. Remember the order seen in the first record and match
 // subsequent records' keys by position with a single memcmp — a hit skips
@@ -455,7 +467,7 @@ struct StickyOrder {
         return e.second;
       }
     }
-    auto it = fields.find(key);
+    auto it = field_find(fields, key);
     int idx = it == fields.end() ? -1 : it->second;
     if (building) {
       order.emplace_back(std::string(key), idx);
@@ -2339,7 +2351,7 @@ struct InferState {
   std::string err;
 
   int lookup_or_add(std::string_view name) {
-    auto it = index.find(name);
+    auto it = field_find(index, name);
     if (it != index.end()) return it->second;
     cols.emplace_back();
     cols.back().name.assign(name.data(), name.size());

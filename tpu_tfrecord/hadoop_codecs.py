@@ -330,6 +330,27 @@ _RAW_CODECS = {
 _BLOCK_SIZE = 256 * 1024
 
 
+def compress_hadoop_blocks(codec: str, data) -> bytes:
+    """Compress one slab into whole BlockCompressorStream blocks. Blocks are
+    self-delimiting (uncompressed-length + chunk-length headers), so the
+    concatenation of slabs compressed independently is exactly the stream
+    HadoopBlockFile would have produced for the concatenated plaintext with
+    aligned block boundaries — this is what lets the parallel writer
+    compress snappy/lz4 slabs on worker threads."""
+    compress, _ = _RAW_CODECS[codec]
+    # one bytes copy per 256KB block (the native compressors take bytes);
+    # the memoryview avoids copying the whole multi-MB slab up front
+    view = memoryview(data).cast("B")
+    out = bytearray()
+    for pos in range(0, len(view), _BLOCK_SIZE):
+        block = bytes(view[pos : pos + _BLOCK_SIZE])
+        comp = compress(block)
+        out += len(block).to_bytes(4, "big")
+        out += len(comp).to_bytes(4, "big")
+        out += comp
+    return bytes(out)
+
+
 class HadoopBlockFile(io.RawIOBase):
     """BlockCompressorStream/BlockDecompressorStream wire layout shared by
     Hadoop's SnappyCodec and Lz4Codec: per block a 4-byte big-endian

@@ -56,9 +56,18 @@ class Metrics:
         with self._lock:
             return self._stages.setdefault(stage, StageStats())
 
-    def snapshot(self) -> Dict[str, Dict[str, float]]:
+    def snapshot(self, prefix: Optional[str] = None) -> Dict[str, Dict[str, float]]:
+        """Per-stage throughput map; ``prefix`` filters to one stage family
+        (e.g. ``'write'`` -> write, write.encode, write.compress, write.io
+        — the breakdown the write bench reports)."""
         with self._lock:
-            return {name: st.throughput() for name, st in self._stages.items()}
+            return {
+                name: st.throughput()
+                for name, st in self._stages.items()
+                if prefix is None
+                or name == prefix
+                or name.startswith(prefix + ".")
+            }
 
     def reset(self) -> None:
         with self._lock:

@@ -54,6 +54,16 @@ class TFRecordOptions:
       - infer_sample_limit: cap records scanned per file during schema
         inference (the reference scans a whole file, README.md:73-74 calls the
         extra pass "expensive" — this bounds it; None = full file parity).
+      - write_workers: encode/compress worker threads for the write pipeline
+        (1 = the sequential legacy path, byte-identical to older releases).
+      - num_shards: round-robin the output of one task over this many shard
+        streams per partition directory (the reference gets multi-file output
+        from Spark task parallelism; here one task drives N streams). Setting
+        it engages the slab pipeline even at write_workers=1 so output bytes
+        are a function of the data and options, never the worker count.
+      - max_records_per_shard: rotate to a new shard file once a stream has
+        written this many records (the option-level spelling of the writer's
+        ``max_records_per_file`` constructor argument).
     """
 
     record_type: RecordType = RecordType.EXAMPLE
@@ -61,6 +71,9 @@ class TFRecordOptions:
     schema: Optional[StructType] = None
     verify_crc: bool = True
     infer_sample_limit: Optional[int] = None
+    write_workers: int = 1
+    num_shards: Optional[int] = None
+    max_records_per_shard: Optional[int] = None
 
     _KNOWN_KEYS = (
         "recordType",
@@ -71,6 +84,12 @@ class TFRecordOptions:
         "verifyCrc",
         "infer_sample_limit",
         "inferSampleLimit",
+        "write_workers",
+        "writeWorkers",
+        "num_shards",
+        "numShards",
+        "max_records_per_shard",
+        "maxRecordsPerShard",
     )
 
     @staticmethod
@@ -95,6 +114,23 @@ class TFRecordOptions:
             limit = int(limit)
             if limit <= 0:
                 raise ValueError("infer_sample_limit must be positive")
+        write_workers = int(
+            merged.pop("write_workers", merged.pop("writeWorkers", 1))
+        )
+        if write_workers < 1:
+            raise ValueError("write_workers must be >= 1")
+        num_shards = merged.pop("num_shards", merged.pop("numShards", None))
+        if num_shards is not None:
+            num_shards = int(num_shards)
+            if num_shards < 1:
+                raise ValueError("num_shards must be >= 1")
+        max_per_shard = merged.pop(
+            "max_records_per_shard", merged.pop("maxRecordsPerShard", None)
+        )
+        if max_per_shard is not None:
+            max_per_shard = int(max_per_shard)
+            if max_per_shard < 1:
+                raise ValueError("max_records_per_shard must be >= 1")
         if merged:
             import difflib
 
@@ -116,6 +152,9 @@ class TFRecordOptions:
             schema=schema,
             verify_crc=verify_crc,
             infer_sample_limit=limit,
+            write_workers=write_workers,
+            num_shards=num_shards,
+            max_records_per_shard=max_per_shard,
         )
 
     def with_schema(self, schema: StructType) -> "TFRecordOptions":

@@ -193,6 +193,60 @@ def codec_extension(codec: Optional[str]) -> str:
     return _CODEC_EXTENSIONS.get(codec, "") if codec else ""
 
 
+def codec_supports_chunks(codec: Optional[str]) -> bool:
+    """True if ``compress_chunk`` can emit independently-decodable pieces
+    for this codec (concatenating chunks yields a valid stream). True for
+    every supported codec today; the probe exists so a future stream-only
+    codec degrades the parallel writer to committer-side compression instead
+    of producing corrupt files."""
+    return normalize_codec(codec) in (
+        None, "gzip", "deflate", "zstd", "snappy", "lz4", "bzip2"
+    )
+
+
+def compress_chunk(codec: Optional[str], data) -> bytes:
+    """Compress one slab into a self-contained piece of ``codec``'s stream
+    format, such that the byte-concatenation of chunks is a valid file of
+    that codec. This is what lets the parallel writer compress slabs on
+    worker threads instead of serializing behind one stream object:
+
+    - gzip: one gzip member (multi-member files are standard; GzipFile
+      reads them). ``mtime=0`` keeps output a pure function of the input.
+    - deflate: one zlib stream (the read side handles concatenated
+      streams, mirroring how it already handles concatenated zstd frames).
+    - zstd: one zstd frame.
+    - bzip2: one bz2 stream (stdlib reads multi-stream files).
+    - snappy/lz4: a whole number of Hadoop BlockCompressorStream blocks
+      (blocks are independent by construction).
+
+    Deterministic: equal input bytes yield equal output bytes, so shard
+    content is a function of the data and options, never of worker timing.
+    """
+    codec = normalize_codec(codec)
+    # zlib/gzip/bz2/zstd accept any buffer (numpy arrays included) — no
+    # bytes() copy of a multi-MB slab on the worker's hot path
+    if codec is None:
+        return bytes(data)
+    if codec == "gzip":
+        return gzip.compress(data, compresslevel=9, mtime=0)
+    if codec == "deflate":
+        return zlib.compress(data)
+    if codec == "zstd":
+        zstd = _zstandard()
+        if zstd is None:  # normalize_codec guards; defensive
+            raise ValueError("zstd codec requires the optional 'zstandard' package")
+        return zstd.ZstdCompressor().compress(data)
+    if codec == "bzip2":
+        import bz2
+
+        return bz2.compress(data)
+    if codec in ("snappy", "lz4"):
+        from tpu_tfrecord.hadoop_codecs import compress_hadoop_blocks
+
+        return compress_hadoop_blocks(codec, data)
+    raise ValueError(f"codec {codec!r} has no chunked compressor")
+
+
 def codec_from_path(path: str) -> Optional[str]:
     """Infer the codec from a file extension, like Hadoop's codec factory."""
     lower = path.lower()
@@ -373,6 +427,12 @@ class _DeflateFile(io.RawIOBase):
     step (mirroring how gzip.open streams), so a large ``.deflate`` shard
     honors the slab-streaming bounded-memory contract (io/dataset.py
     ``_shard_slabs``) instead of materializing whole on open.
+
+    CONCATENATED zlib streams are decoded back to back (the same contract
+    _ZstdFile provides for concatenated frames): the parallel writer's
+    chunked compressor emits one independent stream per slab, and a reader
+    that stopped at the first stream end would silently drop every record
+    after slab 0.
     """
 
     _READ_CHUNK = 1 << 20  # compressed bytes per underlying read
@@ -400,8 +460,32 @@ class _DeflateFile(io.RawIOBase):
 
     def _fill(self, want: int) -> None:
         """Decompress until ``want`` more bytes are pending or EOF; output
-        per step is capped at ``want`` so memory stays ~pending+want."""
+        per step is capped at ``want`` so memory stays ~pending+want. All
+        zlib decode errors surface as TFRecordCorruptionError — the module's
+        corruption contract — including bad bytes where a concatenated
+        stream's header was expected."""
+        try:
+            self._fill_inner(want)
+        except zlib.error as e:
+            raise TFRecordCorruptionError(
+                f"corrupt deflate stream in {self._path}: {e}"
+            ) from e
+
+    def _fill_inner(self, want: int) -> None:
         d = self._decompress
+        if d.eof:
+            # Stream finished: concatenated streams (chunked writer output)
+            # restart a fresh decompressobj on the leftover input, or on the
+            # next read when the stream ended exactly at a chunk boundary.
+            raw = d.unused_data
+            if not raw:
+                raw = self._fh.read(self._READ_CHUNK)
+                if not raw:
+                    self._eof = True
+                    return
+            self._decompress = d = zlib.decompressobj()
+            self._pending += d.decompress(raw, want)
+            return
         if d.unconsumed_tail:
             self._pending += d.decompress(d.unconsumed_tail, want)
             return
