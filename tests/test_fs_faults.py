@@ -16,7 +16,13 @@ import pytest
 import tpu_tfrecord.io as tfio
 from tpu_tfrecord import fs as tfs, wire
 from tpu_tfrecord.io.dataset import TFRecordDataset
+from tpu_tfrecord.retry import RetryPolicy
 from tpu_tfrecord.schema import LongType, StringType, StructField, StructType
+
+
+def _fast_retries(n):
+    """Real retry semantics, injected no-op sleep: no wall-clock cost."""
+    return RetryPolicy(max_retries=n, sleep=lambda _s: None)
 
 fsspec = pytest.importorskip("fsspec")
 
@@ -141,7 +147,7 @@ class TestRemoteReadFaults:
         shards = [s.path for s in tfio.discover_shards(out)]
         faulty_fs.fail_after_bytes = 100  # mid-stream, not on open
         faulty_fs.read_faults = {p: 1 for p in shards}  # one failure each
-        got = _read_all_ids(out, read_retries=2)
+        got = _read_all_ids(out, retry_policy=_fast_retries(2))
         assert sorted(got) == sorted(r[0] for r in ROWS)
         assert all(v == 0 for v in faulty_fs.read_faults.values())  # all fired
 
@@ -151,7 +157,7 @@ class TestRemoteReadFaults:
         faulty_fs.fail_after_bytes = 50
         faulty_fs.read_faults = {shards[0]: 100}  # permanently flaky
         with pytest.raises(OSError, match="injected transient"):
-            _read_all_ids(out, read_retries=2)
+            _read_all_ids(out, retry_policy=_fast_retries(2))
 
     def test_short_and_slow_reads_stream_correctly(self, mem_url, faulty_fs):
         """Object-store-style short reads (every read capped at 7 bytes)

@@ -312,3 +312,195 @@ def normalize_value(v, dt):
     if isinstance(dt, ArrayType):
         return [normalize_value(x, dt.element_type) for x in v]
     return v
+
+
+# ---------------------------------------------------------------------------
+# Byte-flip corruption corpus: on_corrupt="skip_record" salvage (resync)
+# ---------------------------------------------------------------------------
+#
+# Every corruption class the wire can suffer — bad length field, bad
+# length-CRC, bad payload, bad data-CRC, truncated tail — at the head,
+# middle, and tail of a shard, uncompressed and gzip (framing corrupted
+# BEFORE compression: codec-stream corruption is a different failure class,
+# covered by the 'codec' salvage event). skip_record must recover every
+# record except the corrupted frame, and the quota must escalate correctly.
+
+import gzip
+import os
+
+from tpu_tfrecord import wire
+from tpu_tfrecord.io.dataset import TFRecordDataset
+from tpu_tfrecord.metrics import METRICS
+
+_UID_SCHEMA = StructType([StructField("uid", LongType(), nullable=False)])
+_N_RECORDS = 30
+
+
+def _uid_frames():
+    ser = TFRecordSerializer(_UID_SCHEMA)
+    frames = [
+        wire.encode_record(encode_row(ser, RecordType.EXAMPLE, [i]))
+        for i in range(_N_RECORDS)
+    ]
+    offs = [0]
+    for f in frames:
+        offs.append(offs[-1] + len(f))
+    return frames, offs
+
+
+def _flip_offset(offs, frames, frame_idx, kind):
+    """Byte offset to corrupt for one (frame, corruption-kind) pair."""
+    base = offs[frame_idx]
+    payload_len = len(frames[frame_idx]) - wire.HEADER_BYTES - wire.FOOTER_BYTES
+    return {
+        "length": base + 2,
+        "length_crc": base + 9,
+        "payload": base + wire.HEADER_BYTES + 1,
+        "data_crc": base + wire.HEADER_BYTES + payload_len + 1,
+    }[kind]
+
+
+def _write_corpus_shard(dirname, blob, codec):
+    os.makedirs(dirname, exist_ok=True)
+    name = "part-0.tfrecord" + (".gz" if codec == "gzip" else "")
+    data = gzip.compress(bytes(blob), mtime=0) if codec == "gzip" else bytes(blob)
+    path = os.path.join(dirname, name)
+    with open(path, "wb") as fh:
+        fh.write(data)
+    return path
+
+
+def _read_uids(dirname, **kw):
+    ds = TFRecordDataset(
+        dirname, batch_size=7, schema=_UID_SCHEMA, drop_remainder=False, **kw
+    )
+    out = []
+    with ds.batches() as it:
+        for cb in it:
+            out.extend(cb["uid"].values.tolist())
+    return out
+
+
+class TestByteFlipSalvage:
+    @pytest.mark.parametrize("codec", [None, "gzip"])
+    @pytest.mark.parametrize("where", ["head", "middle", "tail"])
+    @pytest.mark.parametrize(
+        "kind", ["length", "length_crc", "payload", "data_crc"]
+    )
+    def test_skip_record_recovers_everything_else(
+        self, tmp_path, codec, where, kind
+    ):
+        frames, offs = _uid_frames()
+        k = {"head": 0, "middle": _N_RECORDS // 2, "tail": _N_RECORDS - 1}[where]
+        blob = bytearray(b"".join(frames))
+        blob[_flip_offset(offs, frames, k, kind)] ^= 0xFF
+        d = str(tmp_path / f"flip_{codec}_{where}_{kind}")
+        _write_corpus_shard(d, blob, codec)
+
+        # default policy: byte-exact parity with today — it raises
+        with pytest.raises(wire.TFRecordCorruptionError):
+            _read_uids(d)
+
+        corrupt0 = METRICS.counter("read.corrupt_records")
+        resync0 = METRICS.counter("read.resyncs")
+        got = _read_uids(d, on_corrupt="skip_record")
+        assert got == [i for i in range(_N_RECORDS) if i != k]
+        assert METRICS.counter("read.corrupt_records") > corrupt0
+        if where != "tail":
+            # mid-stream corruption must land a resync on the next frame
+            assert METRICS.counter("read.resyncs") > resync0
+
+    @pytest.mark.parametrize("codec", [None, "gzip"])
+    def test_skip_record_truncated_tail(self, tmp_path, codec):
+        frames, _ = _uid_frames()
+        blob = bytearray(b"".join(frames))[:-3]  # cut into the last frame
+        d = str(tmp_path / f"trunc_{codec}")
+        _write_corpus_shard(d, blob, codec)
+        with pytest.raises(wire.TFRecordCorruptionError):
+            _read_uids(d)
+        got = _read_uids(d, on_corrupt="skip_record")
+        assert got == list(range(_N_RECORDS - 1))
+
+    def test_codec_stream_corruption_is_one_event(self, tmp_path):
+        """A flipped byte in the COMPRESSED stream (vs the framing) loses
+        the rest of the shard but must charge the quota exactly once — the
+        codec event, not codec + a trailing 'truncated' double-count."""
+        frames, offs = _uid_frames()
+        raw = gzip.compress(b"".join(frames), mtime=0)
+        blob = bytearray(raw)
+        blob[len(blob) // 2] ^= 0xFF  # corrupt the gzip stream itself
+        d = str(tmp_path / "codec")
+        os.makedirs(d)
+        with open(os.path.join(d, "part-0.tfrecord.gz"), "wb") as fh:
+            fh.write(bytes(blob))
+        corrupt0 = METRICS.counter("read.corrupt_records")
+        # quota 1: the single codec event must NOT escalate
+        got = _read_uids(d, on_corrupt="skip_record", max_corrupt_records=1)
+        assert got == list(range(len(got)))  # a valid prefix survives
+        assert len(got) < _N_RECORDS
+        assert METRICS.counter("read.corrupt_records") == corrupt0 + 1
+
+    def test_quota_escalates_to_raise(self, tmp_path):
+        frames, offs = _uid_frames()
+        blob = bytearray(b"".join(frames))
+        bad = (3, 11, 22)
+        for k in bad:
+            blob[_flip_offset(offs, frames, k, "payload")] ^= 0xFF
+        d = str(tmp_path / "quota_raise")
+        _write_corpus_shard(d, blob, None)
+        # quota 3: all three regions tolerated
+        got = _read_uids(d, on_corrupt="skip_record", max_corrupt_records=3)
+        assert got == [i for i in range(_N_RECORDS) if i not in bad]
+        # quota 2: the third region escalates to the default fallback (raise)
+        with pytest.raises(wire.TFRecordCorruptionError, match="max_corrupt_records"):
+            _read_uids(d, on_corrupt="skip_record", max_corrupt_records=2)
+
+    def test_quota_escalates_to_skip_shard(self, tmp_path):
+        frames, offs = _uid_frames()
+        blob = bytearray(b"".join(frames))
+        bad = (3, 11, 22)
+        for k in bad:
+            blob[_flip_offset(offs, frames, k, "payload")] ^= 0xFF
+        d = str(tmp_path / "quota_skip")
+        _write_corpus_shard(d, blob, None)
+        skipped0 = METRICS.counter("read.skipped_shards")
+        got = _read_uids(
+            d,
+            on_corrupt="skip_record",
+            max_corrupt_records=2,
+            corrupt_fallback="skip_shard",
+        )
+        # everything salvaged before the escalating third region
+        assert got == [i for i in range(22) if i not in bad]
+        assert METRICS.counter("read.skipped_shards") == skipped0 + 1
+
+    def test_checkpoint_resume_under_skip_is_deterministic(self, tmp_path):
+        """Skipped frames must not desync record-index accounting: a resume
+        mid-way through a corrupt shard skips exactly the same frames."""
+        frames, offs = _uid_frames()
+        blob = bytearray(b"".join(frames))
+        blob[_flip_offset(offs, frames, 4, "data_crc")] ^= 0xFF
+        blob[_flip_offset(offs, frames, 17, "length_crc")] ^= 0xFF
+        d = str(tmp_path / "resume")
+        _write_corpus_shard(d, blob, None)
+        kw = dict(
+            batch_size=5, schema=_UID_SCHEMA, drop_remainder=False,
+            on_corrupt="skip_record",
+        )
+        full = []
+        with TFRecordDataset(d, **kw).batches() as it:
+            for cb in it:
+                full.extend(cb["uid"].values.tolist())
+        assert full == [i for i in range(_N_RECORDS) if i not in (4, 17)]
+
+        first = []
+        it = TFRecordDataset(d, **kw).batches()
+        for _ in range(2):
+            first.extend(next(it)["uid"].values.tolist())
+        state = it.state()
+        it.close()
+        rest = []
+        with TFRecordDataset(d, **kw).batches(state) as it2:
+            for cb in it2:
+                rest.extend(cb["uid"].values.tolist())
+        assert first + rest == full

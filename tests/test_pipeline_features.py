@@ -7,7 +7,14 @@ import pytest
 
 import tpu_tfrecord.io as tfio
 from tpu_tfrecord.io.dataset import IteratorState, TFRecordDataset
+from tpu_tfrecord.retry import RetryPolicy
 from tpu_tfrecord.schema import FloatType, LongType, StructField, StructType
+
+
+def _fast_retries(n, sleep=None):
+    """Retry policy for tests: real retry semantics, no wall-clock sleeping
+    (``sleep`` hooks let fault tests repair the file 'during' the backoff)."""
+    return RetryPolicy(max_retries=n, sleep=sleep or (lambda _s: None))
 
 SCHEMA = StructType([StructField("uid", LongType()), StructField("v", FloatType())])
 
@@ -168,7 +175,8 @@ class TestMmapPath:
             return real_open(path, mode)
 
         monkeypatch.setattr(dsmod, "_open_local", flaky)
-        ds = TFRecordDataset(out, batch_size=7, schema=SCHEMA, read_retries=2)
+        ds = TFRecordDataset(out, batch_size=7, schema=SCHEMA,
+                             retry_policy=_fast_retries(2))
         assert len(collect_uids(ds)) == 7
         assert calls["n"] == 2
 
@@ -185,9 +193,9 @@ class TestMmapPath:
         def repair(_seconds):
             open(f, "wb").write(good)
 
-        monkeypatch.setattr("tpu_tfrecord.io.dataset.time.sleep", repair)
         ds = TFRecordDataset(
-            out, batch_size=2048, schema=SCHEMA, read_retries=2, drop_remainder=False
+            out, batch_size=2048, schema=SCHEMA, drop_remainder=False,
+            retry_policy=_fast_retries(2, sleep=repair),
         )
         uids = collect_uids(ds)
         assert uids == list(range(3000))  # exactly once each, in order
@@ -285,7 +293,8 @@ class TestRetries:
     def test_transient_io_error_retried(self, sandbox, monkeypatch):
         out = write_shards(sandbox, num_shards=1)
         # use_mmap=False: stream-level fault injection targets the buffered path
-        ds = TFRecordDataset(out, batch_size=7, schema=SCHEMA, read_retries=2,
+        ds = TFRecordDataset(out, batch_size=7, schema=SCHEMA,
+                             retry_policy=_fast_retries(2),
                              drop_remainder=False, use_mmap=False)
         real_open = __import__("tpu_tfrecord.wire", fromlist=["wire"]).open_compressed
         calls = {"n": 0}
@@ -303,8 +312,8 @@ class TestRetries:
 
     def test_exhausted_retries_raise(self, sandbox, monkeypatch):
         out = write_shards(sandbox, num_shards=1)
-        ds = TFRecordDataset(out, batch_size=7, schema=SCHEMA, read_retries=1,
-                             use_mmap=False)
+        ds = TFRecordDataset(out, batch_size=7, schema=SCHEMA,
+                             retry_policy=_fast_retries(1), use_mmap=False)
 
         def always_fail(path, mode, codec):
             raise OSError("gone")
@@ -471,7 +480,8 @@ class TestSlabStreaming:
         # use_mmap=False: stream-level fault injection targets the buffered
         # path (the mmap fast path opens files directly; see use_mmap doc)
         ds = TFRecordDataset(out, batch_size=10, schema=SCHEMA, slab_bytes=200,
-                             read_retries=2, drop_remainder=False, use_mmap=False)
+                             retry_policy=_fast_retries(2),
+                             drop_remainder=False, use_mmap=False)
         uids = collect_uids(ds)
         assert uids == list(range(60))
         assert state["opens"] >= 2  # retried

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""tfrecord_doctor: offline scan/salvage for corrupt TFRecord shards.
+
+The offline complement to the online ``on_corrupt`` read policy: where the
+dataset pipeline resyncs past bad frames at training time, the doctor finds
+them ahead of time and (with ``--repair``) rewrites a shard keeping every
+valid record — so a fleet job can quarantine or fix corrupt inputs instead
+of paying the salvage cost every epoch.
+
+Usage::
+
+    tools/tfrecord_doctor.py DATA_DIR_OR_FILE...          # scan + report
+    tools/tfrecord_doctor.py --repair bad.tfrecord        # + salvage copy
+    tools/tfrecord_doctor.py --repair --out fixed.tfrecord bad.tfrecord
+
+Output is line-oriented JSON on stdout (machine-first; pipe to ``jq`` for
+humans): one ``{"event": "corrupt", ...}`` line per corrupt region (path,
+offset, kind, resync_offset, bytes_skipped) and one
+``{"event": "summary", ...}`` line per file (records, corrupt_events,
+repaired_path when --repair ran). Any codec the reader supports works —
+the codec is inferred from the extension, and repaired files keep it.
+
+Exit status: 0 = every file clean, 1 = corruption found (salvaged if
+--repair), 2 = a file could not be scanned at all.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, Iterator, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_tfrecord import wire  # noqa: E402
+from tpu_tfrecord.io.paths import discover_shards  # noqa: E402
+from tpu_tfrecord.io.reader import salvage_spans_stream  # noqa: E402
+
+
+def iter_valid_records(
+    path: str, events: List[Dict], max_record_bytes: int
+) -> Iterator[bytes]:
+    """Yield every valid record payload in ``path``, appending one dict per
+    corrupt region to ``events``."""
+    for buf, offsets, lengths in salvage_spans_stream(
+        path,
+        on_event=events.append,
+        max_record_bytes=max_record_bytes,
+    ):
+        for off, length in zip(offsets.tolist(), lengths.tolist()):
+            yield bytes(buf[off : off + length])
+
+
+def default_repair_path(path: str) -> str:
+    """``x.tfrecord.gz`` -> ``_repaired-x.tfrecord.gz``. The leading
+    underscore keeps the copy INVISIBLE to shard discovery (like _SUCCESS):
+    a dataset dir that was doctored in place must not serve both the
+    corrupt original and the salvaged copy to the next read, and a second
+    doctor run must not re-scan repaired output. The full original name is
+    preserved so codec inference by extension keeps working; reading the
+    repaired file by its explicit path bypasses the hidden-file filter."""
+    base = os.path.basename(path)
+    return os.path.join(os.path.dirname(path), "_repaired-" + base)
+
+
+def doctor_file(
+    path: str,
+    repair: bool,
+    out_path: Optional[str],
+    max_record_bytes: int,
+    emit,
+) -> Dict:
+    """Scan (and optionally repair) one shard; emit event lines; return the
+    summary dict (also emitted)."""
+    events: List[Dict] = []
+    records = 0
+    repaired_path = None
+    codec = wire.codec_from_path(path)
+    if repair:
+        repaired_path = out_path or default_repair_path(path)
+        with wire.open_compressed(repaired_path, "wb", codec) as fh:
+            w = wire.RecordWriter(fh)
+            for rec in iter_valid_records(path, events, max_record_bytes):
+                w.write(rec)
+                records += 1
+    else:
+        for _ in iter_valid_records(path, events, max_record_bytes):
+            records += 1
+    for ev in events:
+        emit({"event": "corrupt", "path": path, **ev})
+    summary = {
+        "event": "summary",
+        "path": path,
+        "records": records,
+        "corrupt_events": len(events),
+        "bytes_skipped": sum(int(e.get("bytes_skipped") or 0) for e in events),
+    }
+    if repair:
+        if events or out_path is not None:
+            # an explicit --out is a contract: the caller consumes that
+            # path whether or not the input turned out corrupt
+            summary["repaired_path"] = repaired_path
+        else:
+            # clean input, implicit default path: don't leave a redundant
+            # (and discovery-hidden) copy behind
+            try:
+                os.remove(repaired_path)
+            except OSError:
+                pass
+    emit(summary)
+    return summary
+
+
+def expand_paths(inputs: List[str]) -> List[str]:
+    """Files pass through; directories/globs expand to their data shards."""
+    out: List[str] = []
+    for item in inputs:
+        if os.path.isfile(item):
+            out.append(item)
+        else:
+            out.extend(sh.path for sh in discover_shards(item))
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tfrecord_doctor", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("paths", nargs="+", help="shard files, dirs, or globs")
+    ap.add_argument(
+        "--repair", action="store_true",
+        help="write a .repaired copy keeping every valid record",
+    )
+    ap.add_argument(
+        "--out", default=None,
+        help="explicit output path for --repair (single input file only)",
+    )
+    ap.add_argument(
+        "--max-record-bytes", type=int, default=1 << 30,
+        help="declared lengths beyond this are treated as corrupt (default 1 GiB)",
+    )
+    args = ap.parse_args(argv)
+
+    def emit(obj: Dict) -> None:
+        sys.stdout.write(json.dumps(obj, sort_keys=True) + "\n")
+
+    try:
+        files = expand_paths(args.paths)
+    except (OSError, ValueError) as e:
+        emit({"event": "error", "error": str(e)})
+        return 2
+    if args.out is not None and len(files) != 1:
+        ap.error("--out requires exactly one input file")
+    rc = 0
+    for path in files:
+        try:
+            summary = doctor_file(
+                path, args.repair, args.out, args.max_record_bytes, emit
+            )
+        except Exception as e:  # unreadable file, not just corrupt frames
+            emit({"event": "error", "path": path, "error": str(e)})
+            rc = 2
+            continue
+        if summary["corrupt_events"] and rc == 0:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,6 +8,36 @@ cd "$(dirname "$0")/.."
 echo "== syntax gate (compileall) =="
 python -m compileall -q tpu_tfrecord || exit 1
 
+echo "== tfrecord_doctor self-check =="
+# Write a shard, flip one byte, assert the doctor reports exactly one bad
+# frame and that --repair round-trips every other record — so the salvage
+# CLI can't rot.
+env JAX_PLATFORMS=cpu python - <<'PY' || exit 1
+import json, os, subprocess, sys, tempfile
+
+from tpu_tfrecord import wire
+
+tmp = tempfile.mkdtemp(prefix="tfr_doctor_check_")
+shard = os.path.join(tmp, "self.tfrecord")
+recs = [f"record-{i:03d}-".encode() * 3 for i in range(20)]
+wire.write_records(shard, recs)
+raw = bytearray(open(shard, "rb").read())
+raw[len(raw) // 2] ^= 0xFF  # one flipped byte mid-file
+open(shard, "wb").write(bytes(raw))
+
+out = subprocess.run(
+    [sys.executable, "tools/tfrecord_doctor.py", "--repair", shard],
+    capture_output=True, text=True,
+)
+assert out.returncode == 1, (out.returncode, out.stdout, out.stderr)
+lines = [json.loads(l) for l in out.stdout.splitlines() if l.strip()]
+summary = [l for l in lines if l.get("event") == "summary"][0]
+assert summary["corrupt_events"] == 1, lines
+got = list(wire.read_records(summary["repaired_path"]))
+assert len(got) == 19 and all(r in recs for r in got), len(got)
+print("doctor self-check OK:", json.dumps(summary))
+PY
+
 echo "== tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log

@@ -38,6 +38,7 @@ from tpu_tfrecord.schema import (
 )
 from tpu_tfrecord.options import RecordType, TFRecordOptions
 from tpu_tfrecord.registry import lookup_format, register_format
+from tpu_tfrecord.retry import RetryPolicy
 
 __version__ = "0.1.0"
 

@@ -21,10 +21,9 @@ import json
 import os
 import queue
 import threading
-import time
 import weakref
 from dataclasses import dataclass, field, fields, replace
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import numpy as np
 
@@ -38,9 +37,15 @@ from tpu_tfrecord.columnar import (
     take_rows,
 )
 from tpu_tfrecord.io import paths as p
-from tpu_tfrecord.io.reader import DatasetReader
-from tpu_tfrecord.metrics import METRICS, timed
+from tpu_tfrecord.io.reader import (
+    CorruptQuotaError,
+    DatasetReader,
+    SalvageTracker,
+    salvage_spans_stream,
+)
+from tpu_tfrecord.metrics import METRICS, log_salvage_event, timed
 from tpu_tfrecord.options import TFRecordOptions
+from tpu_tfrecord.retry import RetryPolicy
 from tpu_tfrecord.schema import StructType
 
 
@@ -159,6 +164,7 @@ class TFRecordDataset:
         shuffle_window: int = 0,
         seed: int = 0,
         read_retries: int = 0,
+        retry_policy: Optional[RetryPolicy] = None,
         hash_buckets: Optional[Dict[str, int]] = None,
         pack: Optional[Dict[str, List[str]]] = None,
         slab_bytes: int = 256 << 20,
@@ -225,6 +231,15 @@ class TFRecordDataset:
         self.shuffle_window = shuffle_window
         self.seed = seed
         self.read_retries = read_retries
+        # One policy object owns retry budget + backoff for every transient
+        # read fault (replacing three copy-pasted sleep loops). read_retries
+        # stays as the simple spelling; an explicit RetryPolicy wins and
+        # brings injectable sleep/clock for tests and deadline support.
+        self.retry_policy = (
+            retry_policy
+            if retry_policy is not None
+            else RetryPolicy(max_retries=read_retries)
+        )
         self.slab_bytes = max(1, slab_bytes)
         self.max_record_bytes = max_record_bytes
         # mmap fast path for LOCAL uncompressed shards: decode reads the
@@ -328,48 +343,138 @@ class TFRecordDataset:
                 yield epoch, pos, order[pos], skip
             epoch += 1
 
-    def _decode_shard(self, epoch: int, pos: int, shard_idx: int, skip: int) -> Iterator[tuple]:
-        """Decode one shard into chunk tuples (chunk, epoch, pos, start).
-
-        Shard-level retry (SURVEY.md §5 failure-handling plan; the reference
-        leans on Spark task retry): on a transient IO/corruption error the
-        slab stream restarts, skipping the records already emitted — no
-        duplicates, no holes."""
-        if self._native_decoder is not None:
-            yield from self._decode_shard_fused(epoch, pos, shard_idx, skip)
-            return
-        from tpu_tfrecord.tracing import trace
-
-        chunk_records = max(self.batch_size, 2048)
-        next_index = skip  # record index within the shard to emit next
+    def _retrying(self, make_attempt: Callable[[], Iterator[tuple]]) -> Iterator[tuple]:
+        """Shard-level transient-fault retry (SURVEY.md §5 failure-handling
+        plan; the reference leans on Spark task retry), shared by every
+        decode path: on an IO/corruption error the attempt restarts under
+        ``self.retry_policy`` — each attempt body keeps its own
+        emitted-record accounting, so re-entry skips what was already
+        yielded (no duplicates, no holes)."""
+        pol = self.retry_policy
         attempt = 0
+        start = pol.clock()
         while True:
             try:
-                base = 0
-                for buf, offsets, lengths in self._shard_slabs(self.shards[shard_idx]):
-                    n = len(offsets)
-                    if base + n <= next_index:
-                        base += n
-                        continue
-                    for start in range(max(0, next_index - base), n, chunk_records):
-                        stop = min(start + chunk_records, n)
-                        with timed("decode", METRICS) as t, trace("tfr:decode"):
-                            chunk = self._decode_chunk(
-                                buf, offsets[start:stop], lengths[start:stop]
-                            )
-                            t.records += chunk.num_rows
-                            t.bytes += int(lengths[start:stop].sum())
-                        if self._partition_fields:
-                            self._attach_partition_chunk(chunk, shard_idx)
-                        yield chunk, epoch, pos, base + start
-                        next_index = base + stop
-                    base += n
+                yield from make_attempt()
                 return
             except (OSError, wire.TFRecordCorruptionError):
                 attempt += 1
-                if attempt > self.read_retries:
+                if not pol.pause(attempt, start):
                     raise
-                time.sleep(min(0.1 * 2**attempt, 2.0))
+                METRICS.count("read.retries")
+
+    def _decode_shard(self, epoch: int, pos: int, shard_idx: int, skip: int) -> Iterator[tuple]:
+        """Decode one shard into chunk tuples (chunk, epoch, pos, start),
+        applying the configured ``on_corrupt`` policy:
+
+        - ``raise`` (default): the strict paths, byte-exact legacy behavior.
+        - ``skip_record``: the salvage scanner resyncs past corrupt frames;
+          quota exhaustion escalates to ``corrupt_fallback``.
+        - ``skip_shard``: first corruption (after transient retries) drops
+          the rest of the shard and the epoch continues.
+
+        Record indices in emitted tuples always count EMITTED records, so a
+        checkpoint/resume over a corrupt shard skips the same frames the
+        original pass skipped (the salvage scan is deterministic)."""
+        mode = self.options.on_corrupt
+        if mode == "skip_record":
+            try:
+                yield from self._decode_shard_salvage(epoch, pos, shard_idx, skip)
+            except CorruptQuotaError as e:
+                if self.options.corrupt_fallback == "skip_shard":
+                    self._note_skipped_shard(shard_idx, str(e))
+                    return
+                raise wire.TFRecordCorruptionError(str(e)) from e
+            return
+        if mode == "skip_shard":
+            try:
+                yield from self._decode_shard_strict(epoch, pos, shard_idx, skip)
+            except wire.TFRecordCorruptionError as e:
+                METRICS.count("read.corrupt_records")
+                self._note_skipped_shard(shard_idx, str(e))
+            return
+        yield from self._decode_shard_strict(epoch, pos, shard_idx, skip)
+
+    def _note_skipped_shard(self, shard_idx: int, reason: str) -> None:
+        path = self.shards[shard_idx].path
+        log_salvage_event(path=path, kind="shard_skipped", error=reason)
+        METRICS.count("read.skipped_shards")
+
+    def _emit_chunks(
+        self, slabs: Iterator[tuple], epoch: int, pos: int, shard_idx: int,
+        next_index: List[int],
+    ) -> Iterator[tuple]:
+        """Chunk-decode a (buf, offsets, lengths) slab stream from the
+        resume point: skip the ``next_index[0]`` records already emitted,
+        yield (chunk, epoch, pos, start) tuples, and advance the shared
+        emitted-record cell — ONE owner for the skip/chunk/index accounting
+        used by both the strict two-pass path and the salvage path."""
+        from tpu_tfrecord.tracing import trace
+
+        chunk_records = max(self.batch_size, 2048)
+        base = 0
+        for buf, offsets, lengths in slabs:
+            n = len(offsets)
+            if base + n <= next_index[0]:
+                base += n
+                continue
+            for start in range(max(0, next_index[0] - base), n, chunk_records):
+                stop = min(start + chunk_records, n)
+                with timed("decode", METRICS) as t, trace("tfr:decode"):
+                    chunk = self._decode_chunk(
+                        buf, offsets[start:stop], lengths[start:stop]
+                    )
+                    t.records += chunk.num_rows
+                    t.bytes += int(lengths[start:stop].sum())
+                if self._partition_fields:
+                    self._attach_partition_chunk(chunk, shard_idx)
+                yield chunk, epoch, pos, base + start
+                next_index[0] = base + stop
+            base += n
+
+    def _decode_shard_salvage(
+        self, epoch: int, pos: int, shard_idx: int, skip: int
+    ) -> Iterator[tuple]:
+        """skip_record decode: frames stream through the salvage scanner
+        (valid spans only; corrupt regions resync'd past and reported), and
+        chunks decode exactly like the buffered strict path. Indices count
+        emitted (valid) records — deterministic across resumes."""
+        shard = self.shards[shard_idx]
+        tracker = SalvageTracker(shard.path, self.options)
+        next_index = [skip]  # record index within the shard to emit next
+
+        def attempt() -> Iterator[tuple]:
+            tracker.reset()  # a transient-IO retry re-scans the same regions
+            return self._emit_chunks(
+                salvage_spans_stream(
+                    shard.path,
+                    on_event=tracker,
+                    slab_bytes=self.slab_bytes,
+                    max_record_bytes=self.max_record_bytes,
+                ),
+                epoch, pos, shard_idx, next_index,
+            )
+
+        yield from self._retrying(attempt)
+
+    def _decode_shard_strict(
+        self, epoch: int, pos: int, shard_idx: int, skip: int
+    ) -> Iterator[tuple]:
+        """Strict decode (on_corrupt='raise' semantics): dispatches to the
+        fused/mmap native paths when available, the two-pass Python path
+        otherwise."""
+        if self._native_decoder is not None:
+            yield from self._decode_shard_fused(epoch, pos, shard_idx, skip)
+            return
+        next_index = [skip]  # record index within the shard to emit next
+
+        def attempt() -> Iterator[tuple]:
+            return self._emit_chunks(
+                self._shard_slabs(self.shards[shard_idx]),
+                epoch, pos, shard_idx, next_index,
+            )
+
+        yield from self._retrying(attempt)
 
     # IO scratch sizing for the fused path: big enough that a typical shard
     # (or a full decode chunk) fits in one readinto, small enough to keep
@@ -428,63 +533,59 @@ class TFRecordDataset:
         from tpu_tfrecord.tracing import trace
 
         chunk_records = max(self.batch_size, 2048)
-        next_index = skip
-        attempt = 0
+        next_index = [skip]
         dec = self._native_decoder
         verify = self.options.verify_crc
         shard = self.shards[shard_idx]
-        while True:
-            try:
-                with _open_local(shard.path, "rb") as fh:
-                    size = os.fstat(fh.fileno()).st_size
-                    if size == 0:
-                        return
-                    hint = _make_readahead(fh, size, self.readahead_bytes)
-                    mm = mmap.mmap(fh.fileno(), 0, prot=mmap.PROT_READ)
+
+        def attempt() -> Iterator[tuple]:
+            with _open_local(shard.path, "rb") as fh:
+                size = os.fstat(fh.fileno()).st_size
+                if size == 0:
+                    return
+                hint = _make_readahead(fh, size, self.readahead_bytes)
+                mm = mmap.mmap(fh.fileno(), 0, prot=mmap.PROT_READ)
+                try:
+                    buf = np.frombuffer(mm, np.uint8)
+                    to_skip = next_index[0]
+                    abs_idx = 0
+                    bpos = 0
+                    while True:
+                        hint(bpos)
+                        with timed("decode", METRICS) as t, trace("tfr:decode"):
+                            cb, n_sk, n_done, consumed = dec.scan_decode(
+                                buf, bpos, verify, to_skip, chunk_records,
+                                length=size,
+                                max_record_bytes=self.max_record_bytes,
+                            )
+                            t.records += n_done
+                            t.bytes += consumed - bpos
+                        to_skip -= n_sk
+                        abs_idx += n_sk
+                        bpos = consumed
+                        if n_done == 0:
+                            if bpos != size:
+                                # an oversized declared length raised
+                                # inside scan_decode; what remains here
+                                # is a genuine partial tail frame
+                                raise self._truncated_error(shard.path)
+                            return
+                        if self._partition_fields:
+                            self._attach_partition_chunk(cb, shard_idx)
+                        yield cb, epoch, pos, abs_idx
+                        abs_idx += n_done
+                        next_index[0] = abs_idx
+                finally:
+                    # the numpy view exports mm's buffer: drop it before
+                    # closing, else BufferError; if anything else still
+                    # holds the view, GC closes the map later
                     try:
-                        buf = np.frombuffer(mm, np.uint8)
-                        to_skip = next_index
-                        abs_idx = 0
-                        bpos = 0
-                        while True:
-                            hint(bpos)
-                            with timed("decode", METRICS) as t, trace("tfr:decode"):
-                                cb, n_sk, n_done, consumed = dec.scan_decode(
-                                    buf, bpos, verify, to_skip, chunk_records,
-                                    length=size,
-                                    max_record_bytes=self.max_record_bytes,
-                                )
-                                t.records += n_done
-                                t.bytes += consumed - bpos
-                            to_skip -= n_sk
-                            abs_idx += n_sk
-                            bpos = consumed
-                            if n_done == 0:
-                                if bpos != size:
-                                    # an oversized declared length raised
-                                    # inside scan_decode; what remains here
-                                    # is a genuine partial tail frame
-                                    raise self._truncated_error(shard.path)
-                                return
-                            if self._partition_fields:
-                                self._attach_partition_chunk(cb, shard_idx)
-                            yield cb, epoch, pos, abs_idx
-                            abs_idx += n_done
-                            next_index = abs_idx
-                    finally:
-                        # the numpy view exports mm's buffer: drop it before
-                        # closing, else BufferError; if anything else still
-                        # holds the view, GC closes the map later
-                        try:
-                            del buf
-                            mm.close()
-                        except (BufferError, UnboundLocalError):
-                            pass
-            except (OSError, wire.TFRecordCorruptionError):
-                attempt += 1
-                if attempt > self.read_retries:
-                    raise
-                time.sleep(min(0.1 * 2**attempt, 2.0))
+                        del buf
+                        mm.close()
+                    except (BufferError, UnboundLocalError):
+                        pass
+
+        yield from self._retrying(attempt)
 
     def _decode_shard_fused(
         self, epoch: int, pos: int, shard_idx: int, skip: int
@@ -504,69 +605,65 @@ class TFRecordDataset:
             yield from self._decode_shard_mmap(epoch, pos, shard_idx, skip)
             return
         chunk_records = max(self.batch_size, 2048)
-        next_index = skip  # record index within the shard to emit next
-        attempt = 0
+        next_index = [skip]  # record index within the shard to emit next
         dec = self._native_decoder
         verify = self.options.verify_crc
         scratch = self._io_scratch()
-        while True:
-            try:
-                with wire.open_compressed(shard.path, "rb", codec) as fh:
-                    # Readahead for local shards: hint by the wrapper's
-                    # tell() each refill. For codecs tell() is the DECODED
-                    # offset, which overshoots the raw offset — that only
-                    # makes the window more eager (clamped at file size).
-                    hint = _noop_hint
-                    if not _fs.has_scheme(shard.path):
-                        try:
-                            hint = _make_readahead(
-                                fh, os.path.getsize(shard.path), self.readahead_bytes
-                            )
-                        except OSError:
-                            pass
-                    to_skip = next_index
-                    abs_idx = 0  # shard record index at buffer position bpos
-                    data_len = 0
+
+        def attempt() -> Iterator[tuple]:
+            with wire.open_compressed(shard.path, "rb", codec) as fh:
+                # Readahead for local shards: hint by the wrapper's
+                # tell() each refill. For codecs tell() is the DECODED
+                # offset, which overshoots the raw offset — that only
+                # makes the window more eager (clamped at file size).
+                hint = _noop_hint
+                if not _fs.has_scheme(shard.path):
+                    try:
+                        hint = _make_readahead(
+                            fh, os.path.getsize(shard.path), self.readahead_bytes
+                        )
+                    except OSError:
+                        pass
+                to_skip = next_index[0]
+                abs_idx = 0  # shard record index at buffer position bpos
+                data_len = 0
+                bpos = 0
+                while True:
+                    buf = scratch["buf"]
+                    tail_len = data_len - bpos
+                    if tail_len and bpos:
+                        # compact the (sub-frame) tail to the front
+                        buf[:tail_len] = buf[bpos:data_len].copy()
+                    try:
+                        hint(fh.tell())
+                    except (AttributeError, OSError, ValueError):
+                        hint = _noop_hint
+                    data_len = self._refill_scratch(fh, scratch, tail_len, shard.path)
+                    if data_len < 0:
+                        return
+                    buf = scratch["buf"]
                     bpos = 0
                     while True:
-                        buf = scratch["buf"]
-                        tail_len = data_len - bpos
-                        if tail_len and bpos:
-                            # compact the (sub-frame) tail to the front
-                            buf[:tail_len] = buf[bpos:data_len].copy()
-                        try:
-                            hint(fh.tell())
-                        except (AttributeError, OSError, ValueError):
-                            hint = _noop_hint
-                        data_len = self._refill_scratch(fh, scratch, tail_len, shard.path)
-                        if data_len < 0:
-                            return
-                        buf = scratch["buf"]
-                        bpos = 0
-                        while True:
-                            with timed("decode", METRICS) as t, trace("tfr:decode"):
-                                cb, n_sk, n_done, consumed = dec.scan_decode(
-                                    buf, bpos, verify, to_skip, chunk_records,
-                                    length=data_len,
-                                    max_record_bytes=self.max_record_bytes,
-                                )
-                                t.records += n_done
-                                t.bytes += consumed - bpos
-                            to_skip -= n_sk
-                            abs_idx += n_sk
-                            bpos = consumed
-                            if n_done == 0:
-                                break  # only a tail remains: refill
-                            if self._partition_fields:
-                                self._attach_partition_chunk(cb, shard_idx)
-                            yield cb, epoch, pos, abs_idx
-                            abs_idx += n_done
-                            next_index = abs_idx
-            except (OSError, wire.TFRecordCorruptionError):
-                attempt += 1
-                if attempt > self.read_retries:
-                    raise
-                time.sleep(min(0.1 * 2**attempt, 2.0))
+                        with timed("decode", METRICS) as t, trace("tfr:decode"):
+                            cb, n_sk, n_done, consumed = dec.scan_decode(
+                                buf, bpos, verify, to_skip, chunk_records,
+                                length=data_len,
+                                max_record_bytes=self.max_record_bytes,
+                            )
+                            t.records += n_done
+                            t.bytes += consumed - bpos
+                        to_skip -= n_sk
+                        abs_idx += n_sk
+                        bpos = consumed
+                        if n_done == 0:
+                            break  # only a tail remains: refill
+                        if self._partition_fields:
+                            self._attach_partition_chunk(cb, shard_idx)
+                        yield cb, epoch, pos, abs_idx
+                        abs_idx += n_done
+                        next_index[0] = abs_idx
+
+        yield from self._retrying(attempt)
 
     def _chunk_stream(self, state: IteratorState, stop_event=None) -> Iterator[tuple]:
         """Yield (chunk, epoch, position, start_offset) from the resume point

@@ -14,8 +14,10 @@ explicit), with Spark-style type inference (long -> double -> string).
 
 from __future__ import annotations
 
+import gzip
 import time
-from typing import Any, Dict, Iterator, List, Optional, Sequence
+import zlib
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
@@ -23,10 +25,227 @@ from tpu_tfrecord import wire
 from tpu_tfrecord.infer import infer_from_records, merge_type_maps, type_map_to_schema
 from tpu_tfrecord.io import paths as p
 from tpu_tfrecord.io.paths import Shard
-from tpu_tfrecord.metrics import METRICS
+from tpu_tfrecord.metrics import METRICS, log_salvage_event
 from tpu_tfrecord.options import RecordType, TFRecordOptions
 from tpu_tfrecord.schema import StructField, StructType
 from tpu_tfrecord.serde import Row, TFRecordDeserializer, decode_record
+
+
+class CorruptQuotaError(Exception):
+    """Internal escalation: a shard's ``max_corrupt_records`` quota is
+    exhausted. Deliberately NOT a TFRecordCorruptionError/OSError subclass
+    so it passes through the transient-retry nets untouched; the policy
+    layer converts it to the configured ``corrupt_fallback`` behavior."""
+
+
+class ShardSkip(Exception):
+    """Internal signal: drop the rest of this shard (on_corrupt policy)."""
+
+
+class SalvageTracker:
+    """The ``on_event`` sink for one shard's salvage scan: logs each event
+    as a structured warning, bumps the ``read.*`` counters, and enforces the
+    per-shard policy (skip_shard escalates on the first event; skip_record
+    escalates once ``max_corrupt_records`` is exceeded)."""
+
+    def __init__(self, path: str, options: TFRecordOptions):
+        self.path = path
+        self.on_corrupt = options.on_corrupt
+        self.quota = options.max_corrupt_records
+        self.events = 0
+        self._reported = 0  # high-water mark across transient-IO retries
+
+    def reset(self) -> None:
+        """Restart counting for a transient-IO retry re-scan: the same
+        corrupt regions must not be double-counted against the quota, and
+        (via the ``_reported`` high-water mark) must not re-increment the
+        fleet counters or re-log — the salvage scan is deterministic, so
+        event N of the re-scan is the same region as event N before."""
+        self.events = 0
+
+    def __call__(self, event: Dict[str, Any]) -> None:
+        self.events += 1
+        if self.events > self._reported:
+            self._reported = self.events
+            event = dict(event, path=self.path, policy=self.on_corrupt)
+            log_salvage_event(**event)
+            METRICS.count("read.corrupt_records")
+            if event.get("resync_offset") is not None:
+                METRICS.count("read.resyncs")
+        if self.on_corrupt == "skip_shard":
+            raise ShardSkip(
+                f"corrupt frame at offset {event.get('offset')} in {self.path}"
+            )
+        if self.quota is not None and self.events > self.quota:
+            raise CorruptQuotaError(
+                f"{self.events} corrupt regions in {self.path} exceed "
+                f"max_corrupt_records={self.quota}"
+            )
+
+
+# Codec-level decode failures that end a salvage scan: the TFRecord frames
+# beyond a corrupt compressed region are unrecoverable (the decompressor
+# loses sync), so these convert to one terminal 'codec' event instead of
+# raising. Plain OSError is NOT here — it stays transient/retryable.
+_CODEC_CORRUPTION = (
+    wire.TFRecordCorruptionError,
+    EOFError,
+    zlib.error,
+    gzip.BadGzipFile,
+)
+
+
+def salvage_spans_stream(
+    path: str,
+    on_event: Callable[[Dict[str, Any]], None],
+    slab_bytes: int = 32 << 20,
+    max_record_bytes: int = 1 << 30,
+    codec: str = "auto",
+) -> Iterator[tuple]:
+    """Corruption-tolerant twin of ``scan_spans_stream``: yields
+    (buf, offsets, lengths) span batches of VALID frames only, and instead
+    of raising at the first bad frame, reports it through ``on_event`` and
+    resyncs (wire.resync) to the next plausible header — every record
+    before and after a corrupt region is salvaged. CRCs are always verified
+    here: they are the detection mechanism.
+
+    Events are dicts with ``offset`` (decoded-stream byte offset of the
+    corrupt region), ``kind`` (``length_crc`` | ``data_crc`` | ``length`` |
+    ``truncated`` | ``codec``), ``resync_offset`` (where scanning resumed;
+    None when the rest of the stream was unrecoverable) and
+    ``bytes_skipped``. ``on_event`` may raise to abort the scan (quota /
+    skip-shard escalation); the exception propagates to the caller.
+
+    Memory stays bounded exactly like the strict scanner: complete frames
+    are yielded per slab and only a sub-frame tail (or the 11-byte resync
+    window) carries between reads.
+    """
+    if codec == "auto":
+        codec = wire.codec_from_path(path)
+    H, F = wire.HEADER_BYTES, wire.FOOTER_BYTES
+    with wire.open_compressed(path, "rb", codec) as fh:
+        buf = b""
+        file_off = 0  # decoded-stream offset of buf[0]
+        bad_at: Optional[int] = None  # absolute start of current corrupt region
+        bad_kind = ""
+        eof = False
+        # An on_event exception mid-scan (quota / skip-shard escalation) is
+        # DEFERRED until the current buffer's already-validated frames have
+        # been yielded: everything salvaged before the escalation point is
+        # delivered, and only then does the policy take over.
+        escalate: Optional[BaseException] = None
+        codec_dead = False  # a codec event already reported the stream loss
+        while True:
+            if not eof:
+                want = slab_bytes
+                if bad_at is None and len(buf) >= H:
+                    # pending tail frame (header already CRC-validated and
+                    # length-capped below): read enough to complete it
+                    (declared,) = wire._LEN_STRUCT.unpack_from(buf, 0)
+                    if declared <= max_record_bytes:
+                        want = max(want, H + declared + F - len(buf))
+                try:
+                    data = fh.read(want)
+                except _CODEC_CORRUPTION as e:
+                    try:
+                        on_event(
+                            {
+                                "kind": "codec",
+                                "offset": file_off + len(buf),
+                                "resync_offset": None,
+                                "bytes_skipped": 0,
+                                "error": str(e),
+                            }
+                        )
+                    except BaseException as esc:
+                        escalate = esc
+                    data = b""
+                    eof = True  # the decompressor lost sync: stream over
+                    codec_dead = True
+                if not data:
+                    eof = True
+                else:
+                    buf += data
+            spans: List[tuple] = []
+            pos = 0
+            n = len(buf)
+            while escalate is None:
+                if bad_at is not None:
+                    r = wire.resync(buf, pos, max_record_bytes=max_record_bytes)
+                    if r < 0:
+                        # keep an 11-byte window: a header could straddle
+                        # the slab boundary
+                        pos = n if eof else max(pos, n - (H - 1))
+                        break
+                    try:
+                        on_event(
+                            {
+                                "kind": bad_kind,
+                                "offset": bad_at,
+                                "resync_offset": file_off + r,
+                                "bytes_skipped": file_off + r - bad_at,
+                            }
+                        )
+                    except BaseException as esc:
+                        escalate = esc
+                        break
+                    bad_at = None
+                    pos = r
+                if pos + H > n:
+                    break
+                (length,) = wire._LEN_STRUCT.unpack_from(buf, pos)
+                (length_crc,) = wire._CRC_STRUCT.unpack_from(buf, pos + 8)
+                if wire.masked_crc32c(buf[pos : pos + 8]) != length_crc:
+                    bad_at, bad_kind = file_off + pos, "length_crc"
+                    pos += 1
+                    continue
+                if length > max_record_bytes:
+                    bad_at, bad_kind = file_off + pos, "length"
+                    pos += 1
+                    continue
+                start = pos + H
+                if start + length + F > n:
+                    break  # tail: refill (or terminal truncation at EOF)
+                (data_crc,) = wire._CRC_STRUCT.unpack_from(buf, start + length)
+                if wire.masked_crc32c(buf[start : start + length]) != data_crc:
+                    bad_at, bad_kind = file_off + pos, "data_crc"
+                    pos += 1
+                    continue
+                spans.append((start, length))
+                pos = start + length + F
+            if spans:
+                offsets = np.array([s for s, _ in spans], dtype=np.uint64)
+                lengths = np.array([l for _, l in spans], dtype=np.uint64)
+                yield buf, offsets, lengths
+            if escalate is not None:
+                raise escalate
+            if pos:
+                buf = buf[pos:]
+                file_off += pos
+            if eof:
+                if bad_at is not None:
+                    on_event(
+                        {
+                            "kind": bad_kind,
+                            "offset": bad_at,
+                            "resync_offset": None,
+                            "bytes_skipped": file_off + len(buf) - bad_at,
+                        }
+                    )
+                elif buf and not codec_dead:
+                    # leftover partial frame after a codec failure is the
+                    # SAME physical corruption the codec event already
+                    # reported — a second event would double-charge the
+                    # per-shard quota
+                    on_event(
+                        {
+                            "kind": "truncated",
+                            "offset": file_off,
+                            "resync_offset": None,
+                            "bytes_skipped": len(buf),
+                        }
+                    )
+                return
 
 
 class ShardReader:
@@ -74,6 +293,9 @@ class ShardReader:
         self.close()
 
     def __iter__(self) -> Iterator[Row]:
+        if self._options.on_corrupt != "raise":
+            yield from self._iter_tolerant()
+            return
         self._ensure_open()
         if self._reader is None:
             return
@@ -99,6 +321,57 @@ class ShardReader:
                 if tail:
                     row = row + tail
                 yield row
+        finally:
+            self.close()
+            METRICS.add("read", records=records, nbytes=nbytes, seconds=seconds)
+
+    def _iter_tolerant(self) -> Iterator[Row]:
+        """Row iteration under on_corrupt='skip_record'/'skip_shard': frames
+        stream through the salvage scanner (which owns its file handle), so
+        a corrupt frame costs one record — or, under skip_shard / quota
+        escalation, the rest of this shard — never the whole read."""
+        if self._closed:
+            return
+        opts = self._options
+        tracker = SalvageTracker(self.shard.path, opts)
+        record_type = opts.record_type
+        deserializer = self._deserializer
+        tail = self._partition_tail
+        records = 0
+        nbytes = 0
+        seconds = 0.0
+        clock = time.perf_counter
+        try:
+            # Same timing contract as the strict path: count fetch+decode,
+            # never the time the generator spends suspended at yield.
+            t0 = clock()
+            for buf, offsets, lengths in salvage_spans_stream(
+                self.shard.path, on_event=tracker
+            ):
+                for o, l in zip(offsets.tolist(), lengths.tolist()):
+                    record = bytes(buf[o : o + l])
+                    row = decode_record(deserializer, record_type, record)
+                    records += 1
+                    nbytes += len(record)
+                    if tail:
+                        row = row + tail
+                    seconds += clock() - t0
+                    yield row
+                    t0 = clock()
+            seconds += clock() - t0
+        except ShardSkip as e:
+            log_salvage_event(
+                path=self.shard.path, kind="shard_skipped", error=str(e)
+            )
+            METRICS.count("read.skipped_shards")
+        except CorruptQuotaError as e:
+            if opts.corrupt_fallback == "skip_shard":
+                log_salvage_event(
+                    path=self.shard.path, kind="shard_skipped", error=str(e)
+                )
+                METRICS.count("read.skipped_shards")
+            else:
+                raise wire.TFRecordCorruptionError(str(e)) from e
         finally:
             self.close()
             METRICS.add("read", records=records, nbytes=nbytes, seconds=seconds)
@@ -279,6 +552,26 @@ class DatasetReader:
             limit=limit,
         )
 
+    def _salvage_type_map(self, shard: Shard) -> Dict[str, Any]:
+        """Inference fallback over a corrupt shard: fold the type map over
+        its salvageable records only. Events are deliberately NOT logged or
+        counted here — the tolerant read that follows reports each region
+        exactly once; inference double-counting would skew the fleet
+        counters."""
+
+        def records():
+            for buf, offsets, lengths in salvage_spans_stream(
+                shard.path, on_event=lambda _ev: None
+            ):
+                for off, length in zip(offsets.tolist(), lengths.tolist()):
+                    yield bytes(buf[off : off + length])
+
+        return infer_from_records(
+            records(),
+            self.options.record_type,
+            limit=self.options.infer_sample_limit,
+        )
+
     def _infer_data_schema(self) -> StructType:
         """First non-empty file whose records yield a non-empty schema —
         single scan per candidate file (the reference scans the winning file
@@ -288,10 +581,19 @@ class DatasetReader:
             from tpu_tfrecord.infer import byte_array_schema
 
             return byte_array_schema()
+        tolerant = self.options.on_corrupt != "raise"
         for shard in self.shards:
             if shard.size == 0:
                 continue
-            type_map = self._shard_type_map(shard)
+            try:
+                type_map = self._shard_type_map(shard)
+            except wire.TFRecordCorruptionError:
+                if not tolerant:
+                    raise
+                # under a tolerant read policy a corrupt candidate is not
+                # fatal: infer from this shard's salvageable records (the
+                # same frames the tolerant read will deliver)
+                type_map = self._salvage_type_map(shard)
             if type_map:
                 return type_map_to_schema(type_map)
         raise ValueError(

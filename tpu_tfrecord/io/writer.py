@@ -36,7 +36,10 @@ pipeline's per-slab chunked streams.
 from __future__ import annotations
 
 import collections
+import json
 import os
+import socket
+import time
 import uuid
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Deque, Dict, Iterable, List, Optional, Sequence, Tuple
@@ -45,8 +48,9 @@ import numpy as np
 
 from tpu_tfrecord import fs as _fs, wire
 from tpu_tfrecord.io import paths as p
-from tpu_tfrecord.metrics import METRICS, timed
+from tpu_tfrecord.metrics import METRICS, logger, timed
 from tpu_tfrecord.options import TFRecordOptions
+from tpu_tfrecord.retry import RetryPolicy
 from tpu_tfrecord.schema import StructType
 from tpu_tfrecord.serde import TFRecordSerializer, encode_row
 from tpu_tfrecord.tracing import trace
@@ -111,6 +115,7 @@ class DatasetWriter:
         mode: str = "error",
         max_records_per_file: Optional[int] = None,
         write_success: bool = True,
+        retry_policy: Optional[RetryPolicy] = None,
     ):
         mode = (mode or "error").lower()
         if mode not in SAVE_MODES:
@@ -132,6 +137,16 @@ class DatasetWriter:
         )
         self.write_workers = max(1, int(self.options.write_workers))
         self.num_shards = self.options.num_shards
+        # Transient-fault policy for commit-side filesystem ops (shard open,
+        # rename into place, _SUCCESS marker) — the remote-FS path is
+        # demonstrably flaky (tests/test_fs_faults.py). An explicit policy
+        # wins (injectable sleep/clock for tests); write_retries is the
+        # option-level spelling; the default stays fail-fast.
+        self.retry_policy = (
+            retry_policy
+            if retry_policy is not None
+            else RetryPolicy(max_retries=int(self.options.write_retries))
+        )
         # Multi-host jobs: each host commits its own shards with
         # write_success=False and a distinct task_id, then
         # tpu.distributed.finalize_distributed_write barriers and writes the
@@ -255,11 +270,75 @@ class DatasetWriter:
         return _write_batches(self, batches, task_id)
 
 
+#: Name of the per-job liveness marker inside ``_temporary/<job>/``. It
+#: records (pid, host) so a later job in the same output dir can tell a
+#: CRASHED job's staging dir (same host, dead pid → sweep it) from a LIVE
+#: concurrent writer's (leave it alone).
+_JOB_MARKER = "_JOB_META"
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+    except OSError:
+        return True  # unknowable: err on the side of 'alive'
+    return True
+
+
+def sweep_orphan_jobs(fs, output_path: str, keep: Optional[str] = None) -> List[str]:
+    """Best-effort removal of ``_temporary/<job>`` staging dirs left by
+    previous CRASHED jobs in ``output_path``: a dir whose marker names a
+    dead pid on THIS host is orphaned garbage that would otherwise shadow
+    the shared ``_temporary`` parent forever (commit's rmdir keeps failing)
+    and accumulate partial shard bytes. Dirs without a readable marker, or
+    stamped by another host, may belong to live writers — left alone.
+    Returns the removed dirs. Never raises (hygiene must not fail a job)."""
+    removed: List[str] = []
+    root = os.path.join(output_path, p.TEMP_PREFIX)
+    try:
+        if not fs.isdir(root):
+            return removed
+        host = socket.gethostname()
+        for entry in fs.listdir(root):
+            if entry == keep:
+                continue
+            job_dir = os.path.join(root, entry)
+            try:
+                if not fs.isdir(job_dir):
+                    continue
+                with fs.open(os.path.join(job_dir, _JOB_MARKER), "rb") as fh:
+                    meta = json.loads(fh.read().decode("utf-8"))
+                if meta.get("host") != host:
+                    continue
+                pid = int(meta.get("pid", -1))
+                if pid <= 0 or _pid_alive(pid):
+                    continue
+            except Exception:
+                continue  # no/unreadable marker: can't judge, leave it
+            try:
+                fs.rmtree(job_dir, ignore_errors=True)
+                removed.append(job_dir)
+                logger.warning(
+                    "tfrecord.write swept orphaned staging dir %s "
+                    "(crashed job, pid %s)", job_dir, pid,
+                )
+            except Exception:
+                pass
+    except Exception:
+        pass
+    return removed
+
+
 class _WriteJob:
     """Shared scaffolding for one logical write job: a job-scoped temp dir
     under ``_temporary/<job>/``, shard allocation, and the single end-of-job
     commit (rename into place + ``_SUCCESS``). A failed job leaves NOTHING in
-    the final directory and never touches other jobs' temp dirs."""
+    the final directory and never touches other LIVE jobs' temp dirs (it
+    does sweep staging left by crashed jobs — see sweep_orphan_jobs)."""
 
     def __init__(self, writer: "DatasetWriter", task_id: int):
         self.writer = writer
@@ -278,6 +357,20 @@ class _WriteJob:
                 continue
         else:
             raise OSError(f"could not create job temp dir {self.temp_root}")
+        try:
+            with self.fs.open(os.path.join(self.temp_root, _JOB_MARKER), "wb") as fh:
+                fh.write(
+                    json.dumps(
+                        {
+                            "pid": os.getpid(),
+                            "host": socket.gethostname(),
+                            "created": time.time(),
+                            "task_id": task_id,
+                        }
+                    ).encode("utf-8")
+                )
+        except OSError:
+            pass  # marker is best-effort: its absence only disables sweeping
         self.ext = writer.options.file_extension()
         self._seq: Dict[str, int] = {}
         self._final_of: Dict[str, str] = {}
@@ -310,9 +403,35 @@ class _WriteJob:
         self._final_of[tmp_path] = os.path.join(final_dir, fname)
         return tmp_path
 
+    def _commit_op(self, fn: Callable, recovered: Optional[Callable[[], bool]] = None):
+        """One commit-side filesystem op under the writer's RetryPolicy.
+        ``recovered()`` (optional) reports that a failed attempt actually
+        took effect (e.g. the rename landed before the error surfaced) so
+        the op is not blindly re-run."""
+        pol = self.writer.retry_policy
+        attempt = 0
+        start = pol.clock()
+        while True:
+            try:
+                return fn()
+            except OSError:
+                if recovered is not None:
+                    try:
+                        if recovered():
+                            return None
+                    except OSError:
+                        pass
+                attempt += 1
+                if not pol.pause(attempt, start):
+                    raise
+                METRICS.count("write.commit_retries")
+
     def new_shard(self, rel: str = "") -> ShardWriter:
-        return ShardWriter(
-            self.alloc_shard_path(rel), self.writer.data_schema, self.writer.options
+        # the open is a commit-side fs op (remote stores briefly refuse
+        # creates): retryable — nothing is written yet
+        path = self.alloc_shard_path(rel)
+        return self._commit_op(
+            lambda: ShardWriter(path, self.writer.data_schema, self.writer.options)
         )
 
     def retire(self, shard_writer: ShardWriter) -> None:
@@ -325,13 +444,27 @@ class _WriteJob:
         self._pending.append(path)
 
     def commit(self) -> List[str]:
+        # Pre-commit hygiene: staging left by a crashed previous job on this
+        # host would pin the shared _temporary parent (the rmdir below would
+        # fail forever) — sweep it before renaming into place.
+        sweep_orphan_jobs(self.fs, self.writer.output_path, keep=self.job_id)
         written = []
         for tmp_path in self._pending:
             final_path = self._final_of[tmp_path]
             # inline _commit_shard with the job's dir cache: partitioned
             # jobs commit many shards into few directories
-            self._ensure_dir(os.path.dirname(final_path))
-            self.fs.rename(tmp_path, final_path)
+
+            def rename_one(tmp=tmp_path, final=final_path):
+                self._ensure_dir(os.path.dirname(final))
+                self.fs.rename(tmp, final)
+
+            def rename_landed(tmp=tmp_path, final=final_path):
+                # the failed attempt may have won anyway (remote stores can
+                # error after the copy); don't re-run a landed rename
+                self._made_dirs.discard(os.path.dirname(final))
+                return self.fs.exists(final) and not self.fs.exists(tmp)
+
+            self._commit_op(rename_one, recovered=rename_landed)
             written.append(final_path)
         self.fs.rmtree(self.temp_root, ignore_errors=True)
         try:
@@ -340,11 +473,16 @@ class _WriteJob:
         except OSError:
             pass
         if self.writer.write_success:
-            p.write_success_marker(self.writer.output_path)
+            self._commit_op(
+                lambda: p.write_success_marker(self.writer.output_path)
+            )
         return written
 
     def abort(self) -> None:
         self.fs.rmtree(self.temp_root, ignore_errors=True)
+        # abort-side hygiene: also clear staging orphaned by CRASHED jobs so
+        # a retry of this job starts from a clean _temporary
+        sweep_orphan_jobs(self.fs, self.writer.output_path, keep=self.job_id)
         # if this job created the output dir, remove it again when empty so
         # a retry sees the same save-mode world as the first attempt
         if getattr(self.writer, "_created_output", False):
@@ -505,7 +643,9 @@ class _SlabPipeline:
                 if stream.sink is not None:
                     stream.sink.close()
                     self.job.retire_path(stream.sink_path)
-                stream.sink = _RawShardSink(path, self._sink_codec)
+                stream.sink = self.job._commit_op(
+                    lambda: _RawShardSink(path, self._sink_codec)
+                )
                 stream.sink_path = path
             stream.sink.write_slab(payload, n_records)
             t.records = n_records

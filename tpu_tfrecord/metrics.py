@@ -9,6 +9,7 @@ record) and thread-safe.
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
 import time
@@ -52,6 +53,19 @@ class Metrics:
             st.batches += 1
             st.seconds += seconds
 
+    def count(self, stage: str, n: int = 1) -> None:
+        """Increment a pure event counter (the ``records`` field carries the
+        count). Used by the robustness counters: ``read.corrupt_records``,
+        ``read.resyncs``, ``read.retries``, ``read.skipped_shards``,
+        ``write.commit_retries``."""
+        self.add(stage, records=n)
+
+    def counter(self, stage: str) -> int:
+        """Current value of a ``count()``-style counter (0 if never hit)."""
+        with self._lock:
+            st = self._stages.get(stage)
+            return st.records if st is not None else 0
+
     def stage(self, stage: str) -> StageStats:
         with self._lock:
             return self._stages.setdefault(stage, StageStats())
@@ -76,6 +90,16 @@ class Metrics:
 
 # Process-global default registry.
 METRICS = Metrics()
+
+
+def log_salvage_event(**fields) -> None:
+    """One structured warning per salvage/skip event (corrupt frame found,
+    resync landed, shard dropped): a single machine-parseable JSON line on
+    the package logger, keyed by path/offset/kind. Fleet log pipelines can
+    alert on these without scraping free-form text."""
+    logger.warning(
+        "tfrecord.salvage %s", json.dumps(fields, sort_keys=True, default=str)
+    )
 
 
 class timed:

@@ -64,6 +64,20 @@ class TFRecordOptions:
       - max_records_per_shard: rotate to a new shard file once a stream has
         written this many records (the option-level spelling of the writer's
         ``max_records_per_file`` constructor argument).
+      - on_corrupt: read-side corruption policy. ``"raise"`` (default)
+        propagates TFRecordCorruptionError exactly as before;
+        ``"skip_record"`` resyncs past each bad frame (wire.resync) and
+        keeps every salvageable record, bounded per shard by
+        ``max_corrupt_records``; ``"skip_shard"`` drops the rest of a shard
+        at its first corruption and keeps the epoch going.
+      - max_corrupt_records: per-shard quota of corrupt regions tolerated
+        under ``on_corrupt="skip_record"`` (None = unlimited). Quota
+        exhausted escalates to ``corrupt_fallback``.
+      - corrupt_fallback: what quota exhaustion escalates to —
+        ``"raise"`` (default) or ``"skip_shard"``.
+      - write_retries: transient-fault retries for commit-side filesystem
+        ops (shard open, rename into place, _SUCCESS marker) — the
+        option-level spelling of the writer's RetryPolicy.
     """
 
     record_type: RecordType = RecordType.EXAMPLE
@@ -74,6 +88,10 @@ class TFRecordOptions:
     write_workers: int = 1
     num_shards: Optional[int] = None
     max_records_per_shard: Optional[int] = None
+    on_corrupt: str = "raise"
+    max_corrupt_records: Optional[int] = 100
+    corrupt_fallback: str = "raise"
+    write_retries: int = 0
 
     _KNOWN_KEYS = (
         "recordType",
@@ -90,7 +108,18 @@ class TFRecordOptions:
         "numShards",
         "max_records_per_shard",
         "maxRecordsPerShard",
+        "on_corrupt",
+        "onCorrupt",
+        "max_corrupt_records",
+        "maxCorruptRecords",
+        "corrupt_fallback",
+        "corruptFallback",
+        "write_retries",
+        "writeRetries",
     )
+
+    ON_CORRUPT_POLICIES = ("raise", "skip_record", "skip_shard")
+    CORRUPT_FALLBACKS = ("raise", "skip_shard")
 
     @staticmethod
     def from_map(options: Optional[Mapping[str, Any]] = None, **kwargs: Any) -> "TFRecordOptions":
@@ -131,6 +160,34 @@ class TFRecordOptions:
             max_per_shard = int(max_per_shard)
             if max_per_shard < 1:
                 raise ValueError("max_records_per_shard must be >= 1")
+        on_corrupt = str(
+            merged.pop("on_corrupt", merged.pop("onCorrupt", "raise"))
+        ).strip().lower()
+        if on_corrupt not in TFRecordOptions.ON_CORRUPT_POLICIES:
+            raise ValueError(
+                f"on_corrupt must be one of {TFRecordOptions.ON_CORRUPT_POLICIES}, "
+                f"got {on_corrupt!r}"
+            )
+        max_corrupt = merged.pop(
+            "max_corrupt_records", merged.pop("maxCorruptRecords", 100)
+        )
+        if max_corrupt is not None:
+            max_corrupt = int(max_corrupt)
+            if max_corrupt < 0:
+                raise ValueError("max_corrupt_records must be >= 0 (or None)")
+        corrupt_fallback = str(
+            merged.pop("corrupt_fallback", merged.pop("corruptFallback", "raise"))
+        ).strip().lower()
+        if corrupt_fallback not in TFRecordOptions.CORRUPT_FALLBACKS:
+            raise ValueError(
+                f"corrupt_fallback must be one of "
+                f"{TFRecordOptions.CORRUPT_FALLBACKS}, got {corrupt_fallback!r}"
+            )
+        write_retries = int(
+            merged.pop("write_retries", merged.pop("writeRetries", 0))
+        )
+        if write_retries < 0:
+            raise ValueError("write_retries must be >= 0")
         if merged:
             import difflib
 
@@ -155,6 +212,10 @@ class TFRecordOptions:
             write_workers=write_workers,
             num_shards=num_shards,
             max_records_per_shard=max_per_shard,
+            on_corrupt=on_corrupt,
+            max_corrupt_records=max_corrupt,
+            corrupt_fallback=corrupt_fallback,
+            write_retries=write_retries,
         )
 
     def with_schema(self, schema: StructType) -> "TFRecordOptions":

@@ -682,6 +682,42 @@ def scan_buffer_partial(
     return spans, consumed
 
 
+def resync(
+    buf,
+    pos: int,
+    max_record_bytes: Optional[int] = None,
+    end: Optional[int] = None,
+) -> int:
+    """Scan forward from ``pos`` for the next plausible record header, so a
+    shard with one bad frame loses one record instead of everything after
+    it. A candidate offset qualifies when its 8-byte little-endian length is
+    sane (<= ``max_record_bytes`` when given) AND the 4-byte masked
+    length-CRC that follows matches — a ~2^-32 false-positive filter. When
+    the whole candidate frame lies inside ``buf[:end]`` the data CRC must
+    confirm too (~2^-64 combined); a candidate whose frame extends past the
+    buffer is accepted on the header alone and carried by the caller as a
+    tail. Returns the candidate offset, or -1 if none exists — the last
+    HEADER_BYTES-1 bytes can never qualify and should be re-scanned with
+    more data appended.
+    """
+    n = len(buf) if end is None else end
+    i = max(0, pos)
+    while i + HEADER_BYTES <= n:
+        (length,) = _LEN_STRUCT.unpack_from(buf, i)
+        if max_record_bytes is None or length <= max_record_bytes:
+            (length_crc,) = _CRC_STRUCT.unpack_from(buf, i + 8)
+            if masked_crc32c(bytes(buf[i : i + 8])) == length_crc:
+                start = i + HEADER_BYTES
+                if start + length + FOOTER_BYTES <= n:
+                    (data_crc,) = _CRC_STRUCT.unpack_from(buf, start + length)
+                    if masked_crc32c(bytes(buf[start : start + length])) == data_crc:
+                        return i
+                else:
+                    return i
+        i += 1
+    return -1
+
+
 def scan_buffer(
     buf: bytes, verify_crc: bool = True
 ) -> Iterator[Tuple[int, int]]:
