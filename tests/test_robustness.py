@@ -90,7 +90,13 @@ class TestRetryPolicy:
         )
         start = pol.clock()
         assert pol.pause(1, start) and pol.pause(2, start)
-        assert not pol.pause(3, start)  # 2.0 elapsed + 1.0 backoff > 2.5
+        # 2.0 elapsed + 1.0 backoff > 2.5: the backoff is CAPPED to the
+        # remaining 0.5s budget (never sleeps past the deadline) and the
+        # retry is still taken; the NEXT pause finds the budget exhausted
+        assert pol.pause(3, start)
+        assert now[0] == 2.5
+        assert not pol.pause(4, start)
+        assert now[0] == 2.5  # refused without sleeping
 
     def test_call_retries_then_returns(self):
         calls = {"n": 0}

@@ -12,6 +12,13 @@ Usage::
     tools/tfrecord_doctor.py DATA_DIR_OR_FILE...          # scan + report
     tools/tfrecord_doctor.py --repair bad.tfrecord        # + salvage copy
     tools/tfrecord_doctor.py --repair --out fixed.tfrecord bad.tfrecord
+    tools/tfrecord_doctor.py --simulate plan.json shard   # chaos repro
+
+``--simulate plan.json`` replays a deterministic fault plan
+(tpu_tfrecord.faults.FaultPlan JSON) against the scan — the repro half of
+a chaos bug report: the plan that reproduced a field failure in tests can
+be re-run against the real shard, and the emitted ``fault`` events (the
+plan's ledger) show exactly which injected faults fired where.
 
 Output is line-oriented JSON on stdout (machine-first; pipe to ``jq`` for
 humans): one ``{"event": "corrupt", ...}`` line per corrupt region (path,
@@ -141,30 +148,60 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--max-record-bytes", type=int, default=1 << 30,
         help="declared lengths beyond this are treated as corrupt (default 1 GiB)",
     )
+    ap.add_argument(
+        "--simulate", default=None, metavar="PLAN_JSON",
+        help="replay a FaultPlan JSON (tpu_tfrecord.faults) against the "
+        "scan and report its fault ledger — deterministic chaos repro",
+    )
     args = ap.parse_args(argv)
 
     def emit(obj: Dict) -> None:
         sys.stdout.write(json.dumps(obj, sort_keys=True) + "\n")
 
-    try:
-        files = expand_paths(args.paths)
-    except (OSError, ValueError) as e:
-        emit({"event": "error", "error": str(e)})
-        return 2
-    if args.out is not None and len(files) != 1:
-        ap.error("--out requires exactly one input file")
-    rc = 0
-    for path in files:
+    import contextlib
+
+    chaos = contextlib.nullcontext()
+    plan = None
+    if args.simulate is not None:
+        from tpu_tfrecord.faults import FaultPlan, install_chaos
+
         try:
-            summary = doctor_file(
-                path, args.repair, args.out, args.max_record_bytes, emit
-            )
-        except Exception as e:  # unreadable file, not just corrupt frames
-            emit({"event": "error", "path": path, "error": str(e)})
-            rc = 2
-            continue
-        if summary["corrupt_events"] and rc == 0:
-            rc = 1
+            with open(args.simulate) as fh:
+                plan = FaultPlan.from_json(json.load(fh))
+        except (OSError, ValueError) as e:  # missing/bad JSON, bad rule
+            emit({"event": "error", "path": args.simulate,
+                  "error": f"unreadable fault plan: {e}"})
+            return 2
+        chaos = install_chaos(plan)
+
+    try:
+        with chaos:
+            try:
+                files = expand_paths(args.paths)
+            except (OSError, ValueError) as e:
+                emit({"event": "error", "error": str(e)})
+                return 2
+            if args.out is not None and len(files) != 1:
+                ap.error("--out requires exactly one input file")
+            rc = 0
+            for path in files:
+                try:
+                    summary = doctor_file(
+                        path, args.repair, args.out, args.max_record_bytes, emit
+                    )
+                except Exception as e:  # unreadable file, not corrupt frames
+                    emit({"event": "error", "path": path, "error": str(e)})
+                    rc = 2
+                    continue
+                if summary["corrupt_events"] and rc == 0:
+                    rc = 1
+    finally:
+        # the ledger IS the repro report: emit it on every exit path,
+        # including a failed path expansion (possibly failed by the plan's
+        # own injected listdir fault)
+        if plan is not None:
+            for entry in plan.ledger:
+                emit({"event": "fault", **entry})
     return rc
 
 

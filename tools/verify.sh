@@ -38,6 +38,51 @@ assert len(got) == 19 and all(r in recs for r in got), len(got)
 print("doctor self-check OK:", json.dumps(summary))
 PY
 
+echo "== chaos smoke (seeded stall -> deadline -> skip_shard) =="
+# One seeded stall scenario end-to-end: a shard whose read() hangs is
+# converted by the read deadline into a skip_shard, the epoch COMPLETES,
+# and the fault fires exactly as planned (ledger-checked) — so the
+# stall-defense layer can't rot. The injected stall is bounded and the
+# deadline is 100ms: the whole step costs well under a second.
+env JAX_PLATFORMS=cpu python - <<'PY' || exit 1
+import json, os, tempfile
+
+import tpu_tfrecord.io as tfio
+from tpu_tfrecord.faults import FaultPlan, FaultRule, install_chaos
+from tpu_tfrecord.io.dataset import TFRecordDataset
+from tpu_tfrecord.metrics import METRICS
+from tpu_tfrecord.schema import LongType, StructField, StructType
+
+schema = StructType([StructField("id", LongType(), nullable=False)])
+out = os.path.join(tempfile.mkdtemp(prefix="tfr_chaos_smoke_"), "ds")
+for s in range(3):
+    tfio.write([[i] for i in range(s * 20, (s + 1) * 20)], schema, out,
+               mode="append" if s else "overwrite")
+victim = sorted(n for n in os.listdir(out) if n.startswith("part-"))[0]
+plan = FaultPlan([FaultRule(op="read", kind="stall", path=victim,
+                            times=None, stall_ms=60_000)], seed=1)
+ds = TFRecordDataset(out, batch_size=5, schema=schema, drop_remainder=False,
+                     read_deadline_ms=100, on_stall="skip_shard",
+                     use_mmap=False)
+METRICS.reset()
+got = []
+with install_chaos(plan):
+    with ds.batches() as it:
+        for cb in it:
+            got.extend(cb["id"].values.tolist())
+plan.release()
+assert METRICS.counter("read.stalls") >= 1, "no stall detected"
+assert METRICS.counter("read.skipped_shards") == 1, "stalled shard not skipped"
+assert len(got) == 40 and len(set(got)) == 40, (len(got), "epoch incomplete")
+assert plan.ledger and plan.ledger[0]["kind"] == "stall", plan.ledger
+print("chaos smoke OK:", json.dumps({
+    "rows": len(got),
+    "stalls": METRICS.counter("read.stalls"),
+    "skipped_shards": METRICS.counter("read.skipped_shards"),
+    "ledger_events": len(plan.ledger),
+}))
+PY
+
 echo "== tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log

@@ -20,6 +20,10 @@ A from-scratch re-design of the capabilities of linkedin/spark-tfrecord
   ragged SequenceExample padding/bucketing, multi-host shard assignment
   (the reference's data-parallel axis, re-imagined for a TPU pod)
                                                            -> `tpu_tfrecord.tpu`
+- Stall defense: per-op read/open deadlines, hedged shard reads, the
+  pipeline watchdog and the on_stall policy                -> `tpu_tfrecord.stall`
+- Deterministic chaos-FS fault injection (seeded FaultPlan + ChaosFS with
+  a replayable fault ledger)                               -> `tpu_tfrecord.faults`
 """
 
 from tpu_tfrecord.schema import (
@@ -39,6 +43,7 @@ from tpu_tfrecord.schema import (
 from tpu_tfrecord.options import RecordType, TFRecordOptions
 from tpu_tfrecord.registry import lookup_format, register_format
 from tpu_tfrecord.retry import RetryPolicy
+from tpu_tfrecord.stall import DeadlineError, StallError, WatchdogError
 
 __version__ = "0.1.0"
 
@@ -83,6 +88,10 @@ __all__ = [
     "StructType",
     "RecordType",
     "TFRecordOptions",
+    "RetryPolicy",
+    "StallError",
+    "DeadlineError",
+    "WatchdogError",
     "register_format",
     "lookup_format",
     "ensure_jax_platform",

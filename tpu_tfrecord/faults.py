@@ -1,0 +1,367 @@
+"""Deterministic chaos filesystem: seeded fault plans + an injecting wrapper.
+
+PR 2 proved the pipeline against faults that RAISE; the stall-defense layer
+(tpu_tfrecord.stall) defends against faults that merely hang. Both need a
+way to *reproduce* a fault on demand — this module is that reusable
+subsystem: a ``FaultPlan`` (JSON-serializable scenario: which ops, which
+paths, which call ordinals, what kind of fault) and a ``ChaosFS`` wrapper
+over any ``LocalFS``/``FsspecFS`` that executes the plan. Every injected
+fault is appended to a replayable ledger, so a test (or
+``tools/tfrecord_doctor.py --simulate``) can assert exactly what fired and
+a bug report can ship the plan that reproduces a field failure.
+
+Determinism contract: fault decisions depend only on (rule, per-(op, path)
+call ordinal) — never on wall clock or thread scheduling. Probabilistic
+rules draw from a RNG seeded by (plan.seed, rule index, ordinal), so even
+concurrent readers make the same draw for the same call. Same plan + same
+access pattern => byte-identical ledger.
+
+Fault kinds:
+
+- ``transient_error``: raise OSError for ``times`` matching calls, then heal
+  (the retry-path workout).
+- ``permanent_error``: raise OSError on every matching call.
+- ``short_read``: cap each matching read at ``cap_bytes`` (object-store
+  style partial reads; exercises every reader's refill loop).
+- ``stall``: block the matching call for ``stall_ms`` — the hung-read /
+  straggler-shard scenario. The wait goes through the plan's injectable
+  ``sleep`` seam (default: an interruptible Event wait, released by
+  ``plan.release()``), so tests bound wall time or eliminate it entirely.
+- ``rename_race``: let the rename LAND, then raise (the object-store
+  "copy succeeded, error surfaced anyway" race PR 2's landed-rename
+  detection exists for).
+- ``flaky_listing``: raise OSError from listdir/glob/walk_files (a dropped
+  LIST page; discovery must retry or fail loudly, never shrink).
+
+``install_chaos(plan)`` patches the three raw-open seams the real read and
+write paths go through (``fs.filesystem_for``, ``fs.local_open``,
+``io.dataset._open_local``) so chaos reaches every read mode — strict,
+salvage, mmap, fused — and the writer, with zero overhead when not
+installed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import random
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional
+
+FAULT_KINDS = (
+    "transient_error",
+    "permanent_error",
+    "short_read",
+    "stall",
+    "rename_race",
+    "flaky_listing",
+)
+
+#: ops a rule may target. ``read`` covers read()/readinto() on handles the
+#: wrapped FS opened; ``open`` covers the open call itself; ``rename`` and
+#: ``listdir`` cover the write/commit and discovery sides.
+FAULT_OPS = ("open", "read", "rename", "listdir")
+
+
+class InjectedFault(OSError):
+    """Every raising fault ChaosFS injects is this OSError subclass, so the
+    existing transient-retry nets treat it exactly like a real IO error
+    while tests can still tell injected from organic."""
+
+
+@dataclass
+class FaultRule:
+    """One line of a scenario: WHAT fires (kind + params), WHERE (op +
+    path substring), and WHEN (from call ``ordinal`` on, at most ``times``
+    firings; ``probability`` < 1.0 makes eligible calls fire by a seeded,
+    ordinal-keyed coin flip)."""
+
+    op: str
+    kind: str
+    path: str = ""  # substring match against the full path ("" = any)
+    ordinal: int = 0  # first per-(op, path-key) call index eligible to fire
+    times: Optional[int] = 1  # max firings (None = every eligible call)
+    stall_ms: float = 0.0
+    cap_bytes: int = 0
+    probability: float = 1.0
+    error: str = ""
+
+    def __post_init__(self) -> None:
+        if self.op not in FAULT_OPS:
+            raise ValueError(f"op must be one of {FAULT_OPS}, got {self.op!r}")
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"kind must be one of {FAULT_KINDS}, got {self.kind!r}"
+            )
+        if self.ordinal < 0:
+            raise ValueError("ordinal must be >= 0")
+        if self.times is not None and self.times < 1:
+            raise ValueError("times must be >= 1 (or None)")
+        if not (0.0 < self.probability <= 1.0):
+            raise ValueError("probability must be in (0, 1]")
+        if self.kind == "short_read" and self.cap_bytes < 1:
+            # cap 0 would make read() return b"" — indistinguishable from
+            # EOF, i.e. silent truncation instead of a short read
+            raise ValueError("short_read requires cap_bytes >= 1")
+        if self.kind == "stall" and self.stall_ms <= 0:
+            raise ValueError("stall requires stall_ms > 0")
+
+    def matches_path(self, path: str) -> bool:
+        return self.path in path
+
+
+class FaultPlan:
+    """A seeded, deterministic, JSON-round-trippable fault scenario plus the
+    ledger of what actually fired.
+
+    Thread-safe: per-(op, path) call counters and the ledger are mutated
+    under one lock (the pipeline reads from worker threads). ``sleep`` and
+    ``clock`` are injectable seams — the default sleep is an interruptible
+    wait on the plan's release event, so a test can end every in-flight
+    stall at teardown with ``plan.release()``.
+    """
+
+    def __init__(
+        self,
+        rules: List[FaultRule],
+        seed: int = 0,
+        sleep=None,
+        clock=time.monotonic,
+    ):
+        self.rules = list(rules)
+        self.seed = int(seed)
+        self.clock = clock
+        self._released = threading.Event()
+        self.sleep = sleep if sleep is not None else self._default_sleep
+        self._lock = threading.Lock()
+        self._calls: Dict[tuple, int] = {}  # (op, path) -> calls so far
+        self._fired: Dict[int, int] = {}  # rule index -> firings so far
+        self.ledger: List[Dict[str, Any]] = []
+
+    # -- construction / serialization ---------------------------------------
+
+    @staticmethod
+    def from_json(obj: "str | Dict[str, Any]") -> "FaultPlan":
+        if isinstance(obj, str):
+            obj = json.loads(obj)
+        rules = [FaultRule(**r) for r in obj.get("rules", [])]
+        return FaultPlan(rules, seed=int(obj.get("seed", 0)))
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"seed": self.seed, "rules": [asdict(r) for r in self.rules]}
+
+    # -- runtime ------------------------------------------------------------
+
+    def _default_sleep(self, seconds: float) -> None:
+        self._released.wait(seconds)
+
+    def release(self) -> None:
+        """End every in-flight (and future) default-sleep stall immediately
+        — test teardown's escape hatch for abandoned reader threads."""
+        self._released.set()
+
+    def _coin(self, rule_idx: int, ordinal: int, p: float) -> bool:
+        if p >= 1.0:
+            return True
+        # keyed by (seed, rule, ordinal): the same call makes the same draw
+        # no matter how calls from different threads interleave (folded
+        # into one int — tuple seeding is deprecated)
+        key = (self.seed * 1_000_003 + rule_idx) * 1_000_003 + ordinal
+        return random.Random(key).random() < p
+
+    def decide(self, op: str, path: str) -> List[Dict[str, Any]]:
+        """Record one (op, path) call and return the faults that fire on it
+        (already appended to the ledger), in rule order."""
+        fired: List[Dict[str, Any]] = []
+        with self._lock:
+            key = (op, path)
+            n = self._calls.get(key, 0)
+            self._calls[key] = n + 1
+            for idx, rule in enumerate(self.rules):
+                if rule.op != op or not rule.matches_path(path):
+                    continue
+                if n < rule.ordinal:
+                    continue
+                if rule.times is not None and self._fired.get(idx, 0) >= rule.times:
+                    continue
+                if not self._coin(idx, n, rule.probability):
+                    continue
+                self._fired[idx] = self._fired.get(idx, 0) + 1
+                entry = {
+                    "rule": idx,
+                    "op": op,
+                    "path": path,
+                    "ordinal": n,
+                    "kind": rule.kind,
+                }
+                if rule.kind == "stall":
+                    entry["stall_ms"] = rule.stall_ms
+                if rule.kind == "short_read":
+                    entry["cap_bytes"] = rule.cap_bytes
+                self.ledger.append(entry)
+                fired.append(dict(entry, _rule=rule))
+        return fired
+
+    def ledger_json(self) -> str:
+        """Canonical one-line-per-event encoding — what the determinism
+        tests byte-compare across runs."""
+        with self._lock:
+            return "\n".join(json.dumps(e, sort_keys=True) for e in self.ledger)
+
+    # -- fault execution ----------------------------------------------------
+
+    def _raise_for(self, fault: Dict[str, Any]) -> None:
+        rule: FaultRule = fault["_rule"]
+        msg = rule.error or (
+            f"injected {rule.kind} ({fault['op']} #{fault['ordinal']} "
+            f"on {fault['path']})"
+        )
+        raise InjectedFault(msg)
+
+    def apply(self, op: str, path: str, size: Optional[int] = None) -> Optional[int]:
+        """Run the plan for one call: stalls sleep, errors raise, short
+        reads return the capped size (None = uncapped). Multiple rules may
+        fire on one call (e.g. stall THEN transient error)."""
+        cap: Optional[int] = None
+        for fault in self.decide(op, path):
+            kind = fault["kind"]
+            if kind == "stall":
+                self.sleep(fault["_rule"].stall_ms / 1000.0)
+            elif kind == "short_read":
+                c = fault["_rule"].cap_bytes
+                if size is None or size < 0 or size > c:
+                    cap = c if cap is None else min(cap, c)
+            elif kind in ("transient_error", "permanent_error", "flaky_listing"):
+                self._raise_for(fault)
+            # rename_race is handled at the rename call site (the rename
+            # must LAND before the error) — see ChaosFS.rename
+        return cap
+
+
+class _ChaosFile:
+    """Read-side fault executor for one open handle: every read()/readinto()
+    routes through the plan (stalls, errors, short-read caps)."""
+
+    def __init__(self, inner, plan: FaultPlan, path: str):
+        self._inner = inner
+        self._plan = plan
+        self._path = path
+
+    def read(self, size: int = -1):
+        cap = self._plan.apply("read", self._path, size)
+        if cap is not None and (size is None or size < 0 or size > cap):
+            size = cap
+        return self._inner.read(size)
+
+    def readinto(self, b) -> int:
+        # route through read() so every fault kind applies uniformly
+        data = self.read(len(b))
+        b[: len(data)] = data
+        return len(data)
+
+    def write(self, data):
+        return self._inner.write(data)
+
+    def close(self) -> None:
+        self._inner.close()
+
+    @property
+    def closed(self):
+        return self._inner.closed
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __iter__(self):
+        return iter(self._inner)
+
+
+class ChaosFS:
+    """Fault-injecting wrapper over any FS object (LocalFS, FsspecFS, test
+    shims): ``open``/``read``/``rename``/``listdir``-family calls consult
+    the plan; everything else passes through untouched."""
+
+    def __init__(self, inner, plan: FaultPlan):
+        self._fs = inner  # name kept so fs._shares_read_handles can walk it
+        self._plan = plan
+
+    def open(self, path: str, mode: str):
+        self._plan.apply("open", path)
+        inner = self._fs.open(path, mode)
+        if "r" in mode:
+            return _ChaosFile(inner, self._plan, path)
+        return inner
+
+    def rename(self, src: str, dst: str) -> None:
+        fired = self._plan.decide("rename", src)
+        for f in fired:
+            kind = f["kind"]
+            if kind == "stall":
+                self._plan.sleep(f["_rule"].stall_ms / 1000.0)
+            elif kind in ("transient_error", "permanent_error", "flaky_listing"):
+                self._plan._raise_for(f)  # fails BEFORE the rename lands
+        self._fs.rename(src, dst)
+        if any(f["kind"] == "rename_race" for f in fired):
+            raise InjectedFault(
+                f"injected rename_race: rename landed but errored ({src})"
+            )
+
+    def listdir(self, path: str):
+        self._plan.apply("listdir", path)
+        return self._fs.listdir(path)
+
+    def glob(self, pattern: str):
+        self._plan.apply("listdir", pattern)
+        return self._fs.glob(pattern)
+
+    def walk_files(self, root: str, keep):
+        self._plan.apply("listdir", root)
+        return self._fs.walk_files(root, keep)
+
+    def __getattr__(self, name):
+        return getattr(self._fs, name)
+
+
+@contextlib.contextmanager
+def install_chaos(plan: FaultPlan):
+    """Route every filesystem access of the package through ``plan`` for
+    the duration of the with-block: ``fs.filesystem_for`` results are
+    ChaosFS-wrapped (scheme'd paths AND the LocalFS the writer uses),
+    ``fs.local_open`` (the raw-open seam wire.open_compressed uses for
+    plain paths) and ``io.dataset._open_local`` (the mmap fast path's
+    seam) open through the plan. Restores everything on exit and releases
+    any in-flight default-sleep stalls."""
+    from tpu_tfrecord import fs as _fs
+    from tpu_tfrecord.io import dataset as _dataset
+
+    orig_filesystem_for = _fs.filesystem_for
+    orig_local_open = _fs.local_open
+    orig_open_local = _dataset._open_local
+
+    def chaos_filesystem_for(path: str):
+        return ChaosFS(orig_filesystem_for(path), plan)
+
+    def chaos_local_open(path: str, mode: str):
+        if "r" in mode:
+            plan.apply("open", path)
+            return _ChaosFile(orig_local_open(path, mode), plan, path)
+        return orig_local_open(path, mode)
+
+    _fs.filesystem_for = chaos_filesystem_for
+    _fs.local_open = chaos_local_open
+    _dataset._open_local = chaos_local_open
+    try:
+        yield plan
+    finally:
+        _fs.filesystem_for = orig_filesystem_for
+        _fs.local_open = orig_local_open
+        _dataset._open_local = orig_open_local
+        plan.release()

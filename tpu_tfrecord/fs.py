@@ -35,6 +35,15 @@ def has_scheme(path: str) -> bool:
     return bool(_SCHEME_RE.match(str(path)))
 
 
+def local_open(path: str, mode: str) -> BinaryIO:
+    """The ONE raw-open seam for plain (scheme-less) paths on the record
+    read/write hot paths (wire.open_compressed routes through this).
+    Deliberately just ``open``: zero overhead by default, and a single
+    place the deterministic chaos injector (tpu_tfrecord.faults) patches
+    to reach every read mode without touching real deployments."""
+    return open(path, mode)  # noqa: SIM115
+
+
 class LocalFS:
     """Standard-library filesystem — the default for plain paths."""
 

@@ -21,6 +21,7 @@ import json
 import os
 import queue
 import threading
+import time
 import weakref
 from dataclasses import dataclass, field, fields, replace
 from typing import Any, Callable, Dict, Iterator, List, Optional
@@ -47,6 +48,7 @@ from tpu_tfrecord.metrics import METRICS, log_salvage_event, timed
 from tpu_tfrecord.options import TFRecordOptions
 from tpu_tfrecord.retry import RetryPolicy
 from tpu_tfrecord.schema import StructType
+from tpu_tfrecord.stall import StallError, WatchdogError, guard_from_options
 
 
 # Injectable opener for the mmap fast path (it bypasses wire.open_compressed,
@@ -247,6 +249,11 @@ class TFRecordDataset:
         # disk/NFS error surfaces as SIGBUS instead of a retryable OSError —
         # set use_mmap=False on unreliable mounts to keep stream semantics.
         self.use_mmap = use_mmap
+        # Stall defense (tpu_tfrecord.stall): None unless one of the
+        # read_deadline_ms / open_deadline_ms / hedge_after_ms options is
+        # set, so the default hot path pays nothing. The watchdog
+        # (watchdog_timeout_ms) is wired separately in _parallel_chunks.
+        self._stall_guard = guard_from_options(self.options)
         # Sliding posix_fadvise(WILLNEED) window for local shards (0 = off):
         # the kernel fetches ahead ASYNCHRONOUSLY while the C++ decoder
         # chews the current chunk, so cold (non-page-cache-resident) reads
@@ -313,7 +320,16 @@ class TFRecordDataset:
             slab_bytes=self.slab_bytes,
             max_record_bytes=self.max_record_bytes,
             make_hint=make_hint,
+            open_fn=self._guarded_open_fn(),
         )
+
+    def _guarded_open_fn(self):
+        """The (path, codec) opener the span streams use: the stall guard's
+        deadline/hedge open when configured, None (= plain
+        wire.open_compressed) otherwise."""
+        if self._stall_guard is not None:
+            return self._stall_guard.open_compressed
+        return None
 
     def epoch_order(self, epoch: int) -> List[int]:
         """Iteration order over this host's shard list for one epoch.
@@ -364,6 +380,22 @@ class TFRecordDataset:
                 METRICS.count("read.retries")
 
     def _decode_shard(self, epoch: int, pos: int, shard_idx: int, skip: int) -> Iterator[tuple]:
+        """Decode one shard into chunk tuples, applying ``on_corrupt`` (via
+        ``_decode_shard_inner``) and then ``on_stall``: a stall that
+        escaped the transient retries (a DeadlineError from the stall
+        guard) either propagates (``"raise"``, the default) or drops the
+        rest of this shard with the same deterministic skipped-shard
+        accounting corruption uses (``"skip_shard"``)."""
+        try:
+            yield from self._decode_shard_inner(epoch, pos, shard_idx, skip)
+        except StallError as e:
+            if self.options.on_stall != "skip_shard":
+                raise
+            self._note_skipped_shard(shard_idx, str(e), kind="shard_stalled")
+
+    def _decode_shard_inner(
+        self, epoch: int, pos: int, shard_idx: int, skip: int
+    ) -> Iterator[tuple]:
         """Decode one shard into chunk tuples (chunk, epoch, pos, start),
         applying the configured ``on_corrupt`` policy:
 
@@ -395,9 +427,11 @@ class TFRecordDataset:
             return
         yield from self._decode_shard_strict(epoch, pos, shard_idx, skip)
 
-    def _note_skipped_shard(self, shard_idx: int, reason: str) -> None:
+    def _note_skipped_shard(
+        self, shard_idx: int, reason: str, kind: str = "shard_skipped"
+    ) -> None:
         path = self.shards[shard_idx].path
-        log_salvage_event(path=path, kind="shard_skipped", error=reason)
+        log_salvage_event(path=path, kind=kind, error=reason)
         METRICS.count("read.skipped_shards")
 
     def _emit_chunks(
@@ -451,6 +485,7 @@ class TFRecordDataset:
                     on_event=tracker,
                     slab_bytes=self.slab_bytes,
                     max_record_bytes=self.max_record_bytes,
+                    open_fn=self._guarded_open_fn(),
                 ),
                 epoch, pos, shard_idx, next_index,
             )
@@ -539,7 +574,17 @@ class TFRecordDataset:
         shard = self.shards[shard_idx]
 
         def attempt() -> Iterator[tuple]:
-            with _open_local(shard.path, "rb") as fh:
+            # the open runs under the open deadline when configured (mmap
+            # READS are page-cache memory — the open is the only stallable
+            # filesystem op on this path); _open_local resolves at call
+            # time so the chaos injector's patch is honored
+            if self._stall_guard is not None:
+                opened = self._stall_guard.call_open(
+                    lambda: _open_local(shard.path, "rb"), shard.path
+                )
+            else:
+                opened = _open_local(shard.path, "rb")
+            with opened as fh:
                 size = os.fstat(fh.fileno()).st_size
                 if size == 0:
                     return
@@ -611,7 +656,12 @@ class TFRecordDataset:
         scratch = self._io_scratch()
 
         def attempt() -> Iterator[tuple]:
-            with wire.open_compressed(shard.path, "rb", codec) as fh:
+            opener = (
+                (lambda: self._stall_guard.open_compressed(shard.path, codec))
+                if self._stall_guard is not None
+                else (lambda: wire.open_compressed(shard.path, "rb", codec))
+            )
+            with opener() as fh:
                 # Readahead for local shards: hint by the wrapper's
                 # tell() each refill. For codecs tell() is the DECODED
                 # offset, which overshoots the raw offset — that only
@@ -936,19 +986,30 @@ def _put_until_stopped(q: queue.Queue, item, stop: threading.Event) -> None:
 
 class _ShardJob:
     """One shard's decode job in the parallel pipeline: a bounded output
-    queue written by a worker, drained in stream order by the emitter."""
+    queue written by a worker, drained in stream order by the emitter.
 
-    __slots__ = ("task", "out")
+    ``beat`` is the worker's progress heartbeat (monotonic seconds) — it is
+    stamped on every chunk handed over AND on every blocked-put poll
+    iteration, so backpressure (a full queue while the emitter drains
+    earlier shards) never looks like a stall. The watchdog declares the job
+    wedged (``wedged``/``failed``) only when the heartbeat goes silent,
+    which on a daemon worker means it is blocked inside a read that will
+    never return."""
 
-    def __init__(self, task: tuple, depth: int):
+    __slots__ = ("task", "out", "beat", "failed", "wedged")
+
+    def __init__(self, task: tuple, depth: int, now: float = 0.0):
         self.task = task
         self.out: queue.Queue = queue.Queue(maxsize=depth)
+        self.beat = now
+        self.failed: Optional[BaseException] = None
+        self.wedged = False
 
 
 def _parallel_chunks(
     ds: TFRecordDataset, state: IteratorState, stop: threading.Event
 ) -> Iterator[tuple]:
-    """Ordered parallel shard decode.
+    """Ordered parallel shard decode, with an optional watchdog.
 
     A dispatcher enumerates shard tasks lazily (epochs may be infinite) and
     hands each to the worker pool; every task owns a small bounded queue, so
@@ -956,14 +1017,31 @@ def _parallel_chunks(
     ``num_workers`` in-flight shards. The emitter drains task queues in the
     exact task order, so output is identical to the sequential stream —
     checkpoint state and batch contents do not depend on num_workers.
-    """
+
+    With ``watchdog_timeout_ms`` set, a watchdog thread scans the in-flight
+    jobs' progress heartbeats: a worker that goes silent past the timeout
+    (wedged in a read that raises nothing — the failure mode deadlines
+    cannot see when unconfigured) has its job failed with a WatchdogError
+    and a REPLACEMENT worker spawned, so the remaining shards keep decoding
+    instead of the consumer blocking on the dead worker's queue forever.
+    The emitter applies ``on_stall`` to the failed job after draining the
+    chunks it produced before wedging."""
     n_workers = ds.num_workers
     task_q: queue.Queue = queue.Queue(maxsize=n_workers)
     order_q: queue.Queue = queue.Queue(maxsize=n_workers + 1)
     END = object()
+    clock = time.monotonic
+    wd_ms = ds.options.watchdog_timeout_ms
+    wd_timeout = wd_ms / 1000.0 if wd_ms else None
+    inflight: Dict[int, _ShardJob] = {}
+    inflight_lock = threading.Lock()
 
-    def put_checked(q: queue.Queue, item) -> bool:
+    def put_checked(q: queue.Queue, item, job: Optional[_ShardJob] = None) -> bool:
         while not stop.is_set():
+            if job is not None:
+                job.beat = clock()  # blocked-on-full-queue is not a stall
+                if job.wedged:
+                    return False
             try:
                 q.put(item, timeout=0.1)
                 return True
@@ -974,7 +1052,7 @@ def _parallel_chunks(
     def dispatcher() -> None:
         try:
             for task in ds._shard_tasks(state):
-                job = _ShardJob(task, depth=2)
+                job = _ShardJob(task, depth=2, now=clock())
                 if not put_checked(order_q, job):
                     return
                 if not put_checked(task_q, job):
@@ -993,17 +1071,66 @@ def _parallel_chunks(
                 continue
             if job is END:
                 return
+            job.beat = clock()
+            with inflight_lock:
+                inflight[id(job)] = job
             try:
-                for item in ds._decode_shard(*job.task):
-                    if not put_checked(job.out, ("chunk", item)):
+                try:
+                    for item in ds._decode_shard(*job.task):
+                        if not put_checked(job.out, ("chunk", item), job=job):
+                            return
+                        job.beat = clock()
+                    if job.wedged:
+                        return  # declared dead: a replacement already runs
+                    # job= keeps the heartbeat fresh while blocked on a
+                    # full queue — a DONE shard backpressured behind the
+                    # emitter must never look wedged
+                    put_checked(job.out, ("end", None), job=job)
+                except BaseException as e:
+                    if job.wedged:
                         return
-                put_checked(job.out, ("end", None))
-            except BaseException as e:
-                put_checked(job.out, ("error", e))
+                    put_checked(job.out, ("error", e), job=job)
+                    return
+            finally:
+                with inflight_lock:
+                    inflight.pop(id(job), None)
+
+    def watchdog() -> None:
+        interval = max(0.01, wd_timeout / 4.0)
+        while not stop.is_set():
+            stop.wait(interval)
+            if stop.is_set():
                 return
+            now = clock()
+            with inflight_lock:
+                stale = [
+                    j
+                    for j in inflight.values()
+                    if not j.wedged and now - j.beat > wd_timeout
+                ]
+                for j in stale:
+                    inflight.pop(id(j), None)
+            for job in stale:
+                job.wedged = True
+                path = ds.shards[job.task[2]].path
+                job.failed = WatchdogError(
+                    f"shard worker made no progress for "
+                    f"{wd_timeout * 1000:.0f} ms on {path}"
+                )
+                METRICS.count("read.stalls")
+                METRICS.count("read.watchdog_restarts")
+                log_salvage_event(
+                    path=path, kind="watchdog_restart", error=str(job.failed)
+                )
+                # the wedged thread can never be cancelled (blocked in a
+                # C-level read); a fresh worker takes over the task queue
+                # so the epoch keeps decoding
+                threading.Thread(target=worker, daemon=True).start()
 
     threads = [threading.Thread(target=dispatcher, daemon=True)]
     threads += [threading.Thread(target=worker, daemon=True) for _ in range(n_workers)]
+    if wd_timeout is not None:
+        threads.append(threading.Thread(target=watchdog, daemon=True))
     for t in threads:
         t.start()
 
@@ -1018,6 +1145,15 @@ def _parallel_chunks(
             try:
                 kind, payload = job.out.get(timeout=0.1)
             except queue.Empty:
+                if job.failed is not None:
+                    # drained everything the worker produced before it
+                    # wedged; now apply the stall policy
+                    if ds.options.on_stall == "skip_shard":
+                        ds._note_skipped_shard(
+                            job.task[2], str(job.failed), kind="shard_stalled"
+                        )
+                        break
+                    raise job.failed
                 continue
             if kind == "end":
                 break

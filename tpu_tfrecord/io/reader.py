@@ -29,6 +29,7 @@ from tpu_tfrecord.metrics import METRICS, log_salvage_event
 from tpu_tfrecord.options import RecordType, TFRecordOptions
 from tpu_tfrecord.schema import StructField, StructType
 from tpu_tfrecord.serde import Row, TFRecordDeserializer, decode_record
+from tpu_tfrecord.stall import StallError, guard_from_options
 
 
 class CorruptQuotaError(Exception):
@@ -101,6 +102,7 @@ def salvage_spans_stream(
     slab_bytes: int = 32 << 20,
     max_record_bytes: int = 1 << 30,
     codec: str = "auto",
+    open_fn: Optional[Callable[[str, Optional[str]], Any]] = None,
 ) -> Iterator[tuple]:
     """Corruption-tolerant twin of ``scan_spans_stream``: yields
     (buf, offsets, lengths) span batches of VALID frames only, and instead
@@ -122,8 +124,10 @@ def salvage_spans_stream(
     """
     if codec == "auto":
         codec = wire.codec_from_path(path)
+    if open_fn is None:
+        open_fn = lambda p, c: wire.open_compressed(p, "rb", c)  # noqa: E731
     H, F = wire.HEADER_BYTES, wire.FOOTER_BYTES
-    with wire.open_compressed(path, "rb", codec) as fh:
+    with open_fn(path, codec) as fh:
         buf = b""
         file_off = 0  # decoded-stream offset of buf[0]
         bad_at: Optional[int] = None  # absolute start of current corrupt region
@@ -267,14 +271,21 @@ class ShardReader:
         self._options = options
         self._deserializer = TFRecordDeserializer(data_schema)
         self._partition_tail = list(partition_tail)
+        self._guard = guard_from_options(options)
         self._fh = None
         self._reader = None
         self._closed = False
 
+    def _open_stream(self, path: str, codec: Optional[str]):
+        """Open a shard stream, under the stall guard when configured."""
+        if self._guard is not None:
+            return self._guard.open_compressed(path, codec)
+        return wire.open_compressed(path, "rb", codec)
+
     def _ensure_open(self) -> None:
         if self._reader is None and not self._closed:
             codec = wire.codec_from_path(self.shard.path)
-            self._fh = wire.open_compressed(self.shard.path, "rb", codec)
+            self._fh = self._open_stream(self.shard.path, codec)
             self._reader = wire.RecordReader(self._fh, verify_crc=self._options.verify_crc)
 
     def close(self) -> None:
@@ -292,12 +303,22 @@ class ShardReader:
     def __exit__(self, *exc) -> None:
         self.close()
 
+    def _stall_skipped(self, e: "StallError") -> bool:
+        """Apply ``on_stall`` to a stall that escaped the retry nets: True
+        when the policy says drop the rest of this shard (same accounting
+        as on_corrupt='skip_shard', so epochs stay resumable), False when
+        the caller must re-raise."""
+        if self._options.on_stall != "skip_shard":
+            return False
+        log_salvage_event(
+            path=self.shard.path, kind="shard_stalled", error=str(e)
+        )
+        METRICS.count("read.skipped_shards")
+        return True
+
     def __iter__(self) -> Iterator[Row]:
         if self._options.on_corrupt != "raise":
             yield from self._iter_tolerant()
-            return
-        self._ensure_open()
-        if self._reader is None:
             return
         record_type = self._options.record_type
         tail = self._partition_tail
@@ -308,6 +329,9 @@ class ShardReader:
         seconds = 0.0
         clock = time.perf_counter
         try:
+            self._ensure_open()
+            if self._reader is None:
+                return
             while True:
                 t0 = clock()
                 record = self._reader.read()
@@ -321,6 +345,9 @@ class ShardReader:
                 if tail:
                     row = row + tail
                 yield row
+        except StallError as e:
+            if not self._stall_skipped(e):
+                raise
         finally:
             self.close()
             METRICS.add("read", records=records, nbytes=nbytes, seconds=seconds)
@@ -345,8 +372,11 @@ class ShardReader:
             # Same timing contract as the strict path: count fetch+decode,
             # never the time the generator spends suspended at yield.
             t0 = clock()
+            open_fn = (
+                self._guard.open_compressed if self._guard is not None else None
+            )
             for buf, offsets, lengths in salvage_spans_stream(
-                self.shard.path, on_event=tracker
+                self.shard.path, on_event=tracker, open_fn=open_fn
             ):
                 for o, l in zip(offsets.tolist(), lengths.tolist()):
                     record = bytes(buf[o : o + l])
@@ -359,6 +389,9 @@ class ShardReader:
                     yield row
                     t0 = clock()
             seconds += clock() - t0
+        except StallError as e:
+            if not self._stall_skipped(e):
+                raise
         except ShardSkip as e:
             log_salvage_event(
                 path=self.shard.path, kind="shard_skipped", error=str(e)
@@ -384,6 +417,7 @@ def scan_spans_stream(
     max_record_bytes: int = 1 << 30,
     max_records: Optional[int] = None,
     make_hint=None,
+    open_fn: Optional[Callable[[str, Optional[str]], Any]] = None,
 ) -> Iterator[tuple]:
     """Stream one shard as (buf, offsets, lengths) span batches — the ONE
     owner of the slab framing loop (bounded tail-carry: a partial trailing
@@ -401,8 +435,10 @@ def scan_spans_stream(
     from tpu_tfrecord import _native
 
     codec = wire.codec_from_path(path)
+    if open_fn is None:
+        open_fn = lambda p, c: wire.open_compressed(p, "rb", c)  # noqa: E731
     remaining = max_records
-    with wire.open_compressed(path, "rb", codec) as fh:
+    with open_fn(path, codec) as fh:
         hint = make_hint(fh) if make_hint is not None else None
         carry = b""
         native = _native.available()

@@ -240,6 +240,8 @@ class DatasetWriter:
                         w = writers[rel] = job.new_shard(rel)
                     w.write(self._strip_partitions(row))
                     t.records += 1
+                    if t.records % 4096 == 0:
+                        job.heartbeat()
             for w in writers.values():
                 job.retire(w)
         except Exception:
@@ -273,8 +275,23 @@ class DatasetWriter:
 #: Name of the per-job liveness marker inside ``_temporary/<job>/``. It
 #: records (pid, host) so a later job in the same output dir can tell a
 #: CRASHED job's staging dir (same host, dead pid → sweep it) from a LIVE
-#: concurrent writer's (leave it alone).
+#: concurrent writer's (leave it alone), plus a ``heartbeat`` timestamp the
+#: job refreshes while writing — the lease that lets the sweep also reclaim
+#: staging left by writers on OTHER hosts (where pid liveness is
+#: unknowable): a heartbeat stale past the lease TTL means the writer
+#: stopped stamping long ago.
 _JOB_MARKER = "_JOB_META"
+
+#: Seconds between heartbeat re-stamps of _JOB_META (throttle: one tiny
+#: marker rewrite per interval, not per slab).
+_HEARTBEAT_INTERVAL = 60.0
+
+#: Default lease TTL for cross-host orphan sweeping: a staging dir whose
+#: heartbeat is older than this is reclaimable from any host. Generous
+#: (an hour) because false positives delete a LIVE job's staging — clock
+#: skew across hosts must be far smaller than this for the lease to be
+#: sound.
+_LEASE_TTL = 3600.0
 
 
 def _pid_alive(pid: int) -> bool:
@@ -289,20 +306,32 @@ def _pid_alive(pid: int) -> bool:
     return True
 
 
-def sweep_orphan_jobs(fs, output_path: str, keep: Optional[str] = None) -> List[str]:
+def sweep_orphan_jobs(
+    fs,
+    output_path: str,
+    keep: Optional[str] = None,
+    lease_ttl: float = _LEASE_TTL,
+) -> List[str]:
     """Best-effort removal of ``_temporary/<job>`` staging dirs left by
-    previous CRASHED jobs in ``output_path``: a dir whose marker names a
-    dead pid on THIS host is orphaned garbage that would otherwise shadow
-    the shared ``_temporary`` parent forever (commit's rmdir keeps failing)
-    and accumulate partial shard bytes. Dirs without a readable marker, or
-    stamped by another host, may belong to live writers — left alone.
-    Returns the removed dirs. Never raises (hygiene must not fail a job)."""
+    previous CRASHED jobs in ``output_path``. Two independent orphan
+    tests, either is sufficient:
+
+    - same host + dead pid (the PR 2 check: exact but local-only);
+    - marker heartbeat stale past ``lease_ttl`` (works across hosts and on
+      remote stores, where pid liveness is unknowable — live jobs re-stamp
+      their heartbeat every ``_HEARTBEAT_INTERVAL`` seconds, so a lease
+      this stale means the writer died or lost the volume long ago).
+
+    Dirs without a readable marker, or stamped by another LIVE host within
+    the lease, may belong to live writers — left alone. Returns the removed
+    dirs. Never raises (hygiene must not fail a job)."""
     removed: List[str] = []
     root = os.path.join(output_path, p.TEMP_PREFIX)
     try:
         if not fs.isdir(root):
             return removed
         host = socket.gethostname()
+        now = time.time()
         for entry in fs.listdir(root):
             if entry == keep:
                 continue
@@ -312,11 +341,21 @@ def sweep_orphan_jobs(fs, output_path: str, keep: Optional[str] = None) -> List[
                     continue
                 with fs.open(os.path.join(job_dir, _JOB_MARKER), "rb") as fh:
                     meta = json.loads(fh.read().decode("utf-8"))
-                if meta.get("host") != host:
-                    continue
                 pid = int(meta.get("pid", -1))
-                if pid <= 0 or _pid_alive(pid):
+                beat = meta.get("heartbeat", meta.get("created"))
+                lease_stale = (
+                    beat is not None and now - float(beat) > lease_ttl
+                )
+                is_local = meta.get("host") == host and pid > 0
+                if is_local and _pid_alive(pid):
+                    # provably-live local writer: NEVER swept, even with a
+                    # stale lease (heartbeat re-stamps are best-effort and
+                    # can silently fail while the job keeps writing)
                     continue
+                local_dead = is_local and not _pid_alive(pid)
+                if not (local_dead or lease_stale):
+                    continue
+                why = "dead pid" if local_dead else "stale lease"
             except Exception:
                 continue  # no/unreadable marker: can't judge, leave it
             try:
@@ -324,7 +363,7 @@ def sweep_orphan_jobs(fs, output_path: str, keep: Optional[str] = None) -> List[
                 removed.append(job_dir)
                 logger.warning(
                     "tfrecord.write swept orphaned staging dir %s "
-                    "(crashed job, pid %s)", job_dir, pid,
+                    "(crashed job, pid %s, %s)", job_dir, pid, why,
                 )
             except Exception:
                 pass
@@ -357,20 +396,9 @@ class _WriteJob:
                 continue
         else:
             raise OSError(f"could not create job temp dir {self.temp_root}")
-        try:
-            with self.fs.open(os.path.join(self.temp_root, _JOB_MARKER), "wb") as fh:
-                fh.write(
-                    json.dumps(
-                        {
-                            "pid": os.getpid(),
-                            "host": socket.gethostname(),
-                            "created": time.time(),
-                            "task_id": task_id,
-                        }
-                    ).encode("utf-8")
-                )
-        except OSError:
-            pass  # marker is best-effort: its absence only disables sweeping
+        self._created = time.time()
+        self._last_beat = self._created
+        self._write_marker()
         self.ext = writer.options.file_extension()
         self._seq: Dict[str, int] = {}
         self._final_of: Dict[str, str] = {}
@@ -379,6 +407,32 @@ class _WriteJob:
         # writes allocate many shards per partition dir, and on container
         # overlay filesystems each redundant makedirs costs a real syscall.
         self._made_dirs = {self.temp_root}
+
+    def _write_marker(self) -> None:
+        try:
+            with self.fs.open(os.path.join(self.temp_root, _JOB_MARKER), "wb") as fh:
+                fh.write(
+                    json.dumps(
+                        {
+                            "pid": os.getpid(),
+                            "host": socket.gethostname(),
+                            "created": self._created,
+                            "heartbeat": time.time(),
+                            "task_id": self.task_id,
+                        }
+                    ).encode("utf-8")
+                )
+        except OSError:
+            pass  # marker is best-effort: its absence only disables sweeping
+
+    def heartbeat(self) -> None:
+        """Re-stamp the _JOB_META heartbeat (throttled to one marker write
+        per _HEARTBEAT_INTERVAL): the lease the cross-host orphan sweep
+        reads. Cheap enough to call per slab/batch."""
+        now = time.time()
+        if now - self._last_beat >= _HEARTBEAT_INTERVAL:
+            self._last_beat = now
+            self._write_marker()
 
     def _ensure_dir(self, path: str) -> None:
         if path not in self._made_dirs:
@@ -438,6 +492,7 @@ class _WriteJob:
         """Close a finished shard; it stays in temp until commit()."""
         shard_writer.close()
         self._pending.append(shard_writer.path)
+        self.heartbeat()
 
     def retire_path(self, path: str) -> None:
         """Register an already-closed temp file for the end-of-job commit."""
@@ -635,6 +690,7 @@ class _SlabPipeline:
     def _commit_one(self) -> None:
         fut, stream, path = self._inflight.popleft()
         payload, n_records = fut.result()  # re-raises worker errors
+        self.job.heartbeat()  # lease stays fresh for long pipeline jobs
         with trace("tfr.write.io"), timed("write.io", METRICS) as t:
             if stream.sink_path != path:
                 # all slabs of a file precede slabs of the stream's next
@@ -957,6 +1013,7 @@ def _write_batches(
                     w.write(row)
             t.records += piece.num_rows
             pos += take
+        job.heartbeat()
 
     try:
         with timed("write", METRICS) as t:

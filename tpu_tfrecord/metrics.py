@@ -57,7 +57,18 @@ class Metrics:
         """Increment a pure event counter (the ``records`` field carries the
         count). Used by the robustness counters: ``read.corrupt_records``,
         ``read.resyncs``, ``read.retries``, ``read.skipped_shards``,
-        ``write.commit_retries``."""
+        ``write.commit_retries``, and the stall counters (``read.stalls``,
+        ``read.deadline_misses``, ``read.hedges``, ``read.hedge_wins``,
+        ``read.watchdog_restarts``).
+
+        Thread-safety audit (counters are bumped from prefetch workers,
+        stall-guard workers, the watchdog, and writer pipeline threads):
+        every mutation — add/count — and every read — counter/stage/
+        snapshot — takes ``self._lock``, so concurrent increments never
+        lose updates (pinned by tests/test_chaos.py::TestMetricsThreadSafety).
+        The one contract callers must keep: a StageStats object returned by
+        ``stage()`` is a live reference — read its fields, never mutate
+        them outside this class (all in-tree callers only read)."""
         self.add(stage, records=n)
 
     def counter(self, stage: str) -> int:

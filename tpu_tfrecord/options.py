@@ -78,6 +78,27 @@ class TFRecordOptions:
       - write_retries: transient-fault retries for commit-side filesystem
         ops (shard open, rename into place, _SUCCESS marker) — the
         option-level spelling of the writer's RetryPolicy.
+      - read_deadline_ms: per-read deadline for shard byte reads (None =
+        off). A read that exceeds it is converted into a raising
+        DeadlineError (an OSError: it flows through read retries), counted
+        in ``read.stalls``/``read.deadline_misses``.
+      - open_deadline_ms: same deadline model for the shard OPEN call.
+      - hedge_after_ms: straggler hedging — when a read has produced
+        nothing for this long, a backup open+read of the same byte range
+        launches; first result wins (byte-identical either way), the loser
+        is cancelled. Counted in ``read.hedges``/``read.hedge_wins``.
+      - on_stall: what an unrecoverable stall (deadline miss after
+        retries, or a watchdog-detected wedged worker) does to the epoch:
+        ``"raise"`` (default) propagates; ``"skip_shard"`` drops the rest
+        of the stalled shard (counted in ``read.skipped_shards``, same
+        deterministic accounting as ``on_corrupt="skip_shard"``) and the
+        epoch continues.
+      - watchdog_timeout_ms: per-dataset pipeline watchdog (None = off) —
+        a parallel-read shard worker that makes no progress heartbeat for
+        this long is declared wedged: its shard fails with a WatchdogError
+        (handled per ``on_stall``) and a replacement worker is spawned
+        (``read.watchdog_restarts``) so the rest of the epoch keeps
+        decoding instead of blocking on the dead worker's queue forever.
     """
 
     record_type: RecordType = RecordType.EXAMPLE
@@ -92,6 +113,11 @@ class TFRecordOptions:
     max_corrupt_records: Optional[int] = 100
     corrupt_fallback: str = "raise"
     write_retries: int = 0
+    read_deadline_ms: Optional[float] = None
+    open_deadline_ms: Optional[float] = None
+    hedge_after_ms: Optional[float] = None
+    on_stall: str = "raise"
+    watchdog_timeout_ms: Optional[float] = None
 
     _KNOWN_KEYS = (
         "recordType",
@@ -116,10 +142,21 @@ class TFRecordOptions:
         "corruptFallback",
         "write_retries",
         "writeRetries",
+        "read_deadline_ms",
+        "readDeadlineMs",
+        "open_deadline_ms",
+        "openDeadlineMs",
+        "hedge_after_ms",
+        "hedgeAfterMs",
+        "on_stall",
+        "onStall",
+        "watchdog_timeout_ms",
+        "watchdogTimeoutMs",
     )
 
     ON_CORRUPT_POLICIES = ("raise", "skip_record", "skip_shard")
     CORRUPT_FALLBACKS = ("raise", "skip_shard")
+    ON_STALL_POLICIES = ("raise", "skip_shard")
 
     @staticmethod
     def from_map(options: Optional[Mapping[str, Any]] = None, **kwargs: Any) -> "TFRecordOptions":
@@ -188,6 +225,28 @@ class TFRecordOptions:
         )
         if write_retries < 0:
             raise ValueError("write_retries must be >= 0")
+
+        def _pos_ms(snake: str, camel: str) -> Optional[float]:
+            v = merged.pop(snake, merged.pop(camel, None))
+            if v is None:
+                return None
+            v = float(v)
+            if v <= 0:
+                raise ValueError(f"{snake} must be > 0 (or None)")
+            return v
+
+        read_deadline_ms = _pos_ms("read_deadline_ms", "readDeadlineMs")
+        open_deadline_ms = _pos_ms("open_deadline_ms", "openDeadlineMs")
+        hedge_after_ms = _pos_ms("hedge_after_ms", "hedgeAfterMs")
+        watchdog_timeout_ms = _pos_ms("watchdog_timeout_ms", "watchdogTimeoutMs")
+        on_stall = str(
+            merged.pop("on_stall", merged.pop("onStall", "raise"))
+        ).strip().lower()
+        if on_stall not in TFRecordOptions.ON_STALL_POLICIES:
+            raise ValueError(
+                f"on_stall must be one of {TFRecordOptions.ON_STALL_POLICIES}, "
+                f"got {on_stall!r}"
+            )
         if merged:
             import difflib
 
@@ -216,6 +275,11 @@ class TFRecordOptions:
             max_corrupt_records=max_corrupt,
             corrupt_fallback=corrupt_fallback,
             write_retries=write_retries,
+            read_deadline_ms=read_deadline_ms,
+            open_deadline_ms=open_deadline_ms,
+            hedge_after_ms=hedge_after_ms,
+            on_stall=on_stall,
+            watchdog_timeout_ms=watchdog_timeout_ms,
         )
 
     def with_schema(self, schema: StructType) -> "TFRecordOptions":

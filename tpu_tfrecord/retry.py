@@ -42,8 +42,11 @@ class RetryPolicy:
 
     ``max_retries`` counts RETRIES, not attempts: 0 means one attempt and
     no retry (the historical ``read_retries=0`` default). ``deadline``
-    bounds total elapsed time since the caller's ``start`` timestamp: a
-    retry whose backoff would overrun the deadline is not taken.
+    bounds total elapsed time since the caller's ``start`` timestamp: once
+    it is exhausted no retry is taken, and a backoff that would overrun it
+    is CAPPED to the remaining budget — the policy never sleeps past its
+    own deadline (it used to refuse such retries outright, giving up
+    budget that was still available).
     """
 
     max_retries: int = 0
@@ -75,8 +78,12 @@ class RetryPolicy:
             return False
         delay = self.backoff(attempt)
         if self.deadline is not None and start is not None:
-            if (self.clock() - start) + delay > self.deadline:
+            remaining = self.deadline - (self.clock() - start)
+            if remaining <= 0:
                 return False
+            # never sleep past the deadline: spend exactly the remaining
+            # budget on this backoff instead of refusing the retry
+            delay = min(delay, remaining)
         if delay > 0:
             self.sleep(delay)
         return True

@@ -270,7 +270,8 @@ def open_compressed(path: str, mode: str, codec: Optional[str]) -> BinaryIO:
     route through the pluggable filesystem layer (tpu_tfrecord.fs — the
     reference's Hadoop FileSystem + CodecStreams equivalent,
     TFRecordOutputWriter.scala:19); the codec wraps the raw stream either
-    way."""
+    way. Plain paths open through ``fs.local_open`` — the raw-open seam
+    the chaos injector (tpu_tfrecord.faults) patches."""
     codec = normalize_codec(codec)
     from tpu_tfrecord import fs as _fs
 
@@ -282,10 +283,17 @@ def open_compressed(path: str, mode: str, codec: Optional[str]) -> BinaryIO:
             raw = _fs.open_for_read(fsys, path)
         else:
             raw = fsys.open(path, mode)
-    elif codec is None:
-        return open(path, mode)  # noqa: SIM115  (local fast path)
     else:
-        raw = open(path, mode)  # noqa: SIM115
+        raw = _fs.local_open(path, mode)
+    return wrap_codec(path, mode, codec, raw)
+
+
+def wrap_codec(
+    path: str, mode: str, codec: Optional[str], raw: BinaryIO
+) -> BinaryIO:
+    """Wrap an already-open raw byte stream in the codec for ``path`` —
+    the codec half of ``open_compressed``, shared with the stall guard
+    (which inserts its deadline/hedge stream UNDER the codec)."""
     if codec == "gzip":
         return _ClosingGzip(raw, mode)  # type: ignore[return-value]
     if codec == "deflate":
