@@ -1,0 +1,676 @@
+"""Columnar epoch cache suite (ISSUE 4): container round trips, the
+invalidation matrix (source change / decode-affecting option change /
+container version bump => miss; irrelevant option change => hit),
+byte-identical rows and checkpoint-resume interchange between cached and
+uncached reads, the corrupt-cache fallback guarantee, LRU eviction, and
+chaos reaching cache-file opens."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+import tpu_tfrecord.io as tfio
+from tpu_tfrecord import cache as cache_mod
+from tpu_tfrecord import wire
+from tpu_tfrecord.columnar import batch_to_rows
+from tpu_tfrecord.faults import FaultPlan, FaultRule, install_chaos
+from tpu_tfrecord.io.dataset import TFRecordDataset
+from tpu_tfrecord.io.writer import DatasetWriter
+from tpu_tfrecord.metrics import METRICS
+from tpu_tfrecord.options import TFRecordOptions
+from tpu_tfrecord.schema import (
+    ArrayType,
+    FloatType,
+    LongType,
+    StringType,
+    StructField,
+    StructType,
+)
+
+SCHEMA = StructType(
+    [
+        StructField("id", LongType(), nullable=False),
+        StructField("s", StringType()),  # nullable: exercises the mask
+        StructField("arr", ArrayType(LongType())),  # ragged
+    ]
+)
+# every 7th string is null -> masked-out rows round-trip through the cache
+ROWS = [
+    [i, None if i % 7 == 0 else f"v{i}" * (i % 3 + 1), list(range(i % 5))]
+    for i in range(90)
+]
+PER_SHARD = 30  # 3 shards from one deterministic write job
+
+
+@pytest.fixture
+def data_dir(sandbox):
+    out = str(sandbox / "ds")
+    DatasetWriter(out, SCHEMA, mode="overwrite", max_records_per_file=PER_SHARD).write_rows(ROWS)
+    return out
+
+
+@pytest.fixture
+def cache_dir(sandbox):
+    return str(sandbox / "cache")
+
+
+def collect(data_dir, state=None, schema=SCHEMA, **kw):
+    ds = TFRecordDataset(
+        data_dir, batch_size=8, schema=schema, drop_remainder=False,
+        num_epochs=1, **kw,
+    )
+    got = []
+    with ds.batches(state) as it:
+        for b in it:
+            got.extend(batch_to_rows(b, ds.schema))
+    return got
+
+
+def entries_in(cache_dir):
+    if not os.path.isdir(cache_dir):
+        return []
+    return sorted(
+        os.path.join(cache_dir, n)
+        for n in os.listdir(cache_dir)
+        if n.endswith(cache_mod.ENTRY_SUFFIX)
+    )
+
+
+def counters():
+    return {
+        k: METRICS.counter(f"cache.{k}")
+        for k in ("hits", "misses", "bytes_written", "evictions", "corrupt_fallbacks")
+    }
+
+
+class TestOptions:
+    def test_parse_cache_knobs(self):
+        opts = TFRecordOptions.from_map(
+            cache="auto", cacheDir="/tmp/x", cacheMaxBytes="1024"
+        )
+        assert opts.cache == "auto"
+        assert opts.cache_dir == "/tmp/x"
+        assert opts.cache_max_bytes == 1024
+        snake = TFRecordOptions.from_map(
+            cache="auto", cache_dir="/tmp/x", cache_max_bytes=1024
+        )
+        assert snake == opts
+
+    def test_defaults_off(self):
+        opts = TFRecordOptions.from_map()
+        assert opts.cache == "off" and opts.cache_dir is None
+
+    def test_bad_values_raise(self):
+        with pytest.raises(ValueError, match="cache must be one of"):
+            TFRecordOptions.from_map(cache="always")
+        with pytest.raises(ValueError, match="cache_max_bytes"):
+            TFRecordOptions.from_map(cache_max_bytes=0)
+
+
+class TestRoundTrip:
+    def test_rows_byte_identical_and_counted(self, data_dir, cache_dir):
+        base = collect(data_dir)
+        METRICS.reset()
+        first = collect(data_dir, cache="auto", cache_dir=cache_dir)
+        c = counters()
+        assert first == base
+        assert c["misses"] == 3 and c["hits"] == 0 and c["bytes_written"] > 0
+        assert len(entries_in(cache_dir)) == 3
+        METRICS.reset()
+        served = collect(data_dir, cache="auto", cache_dir=cache_dir)
+        c = counters()
+        assert served == base
+        assert c["hits"] == 3 and c["misses"] == 0 and c["bytes_written"] == 0
+
+    def test_second_epoch_of_one_iterator_is_served(self, data_dir, cache_dir):
+        base = collect(data_dir)
+        METRICS.reset()
+        ds = TFRecordDataset(
+            data_dir, batch_size=8, schema=SCHEMA, drop_remainder=False,
+            num_epochs=2, cache="auto", cache_dir=cache_dir,
+        )
+        got = []
+        with ds.batches() as it:
+            for b in it:
+                got.extend(batch_to_rows(b, ds.schema))
+        assert got[: len(base)] == base and got[len(base):] == base
+        assert METRICS.counter("cache.hits") == 3
+
+    def test_ragged2_sequence_example(self, sandbox):
+        schema = StructType(
+            [
+                StructField("label", LongType(), nullable=False),
+                StructField("frames", ArrayType(ArrayType(FloatType()))),
+            ]
+        )
+        rows = [
+            [i, [[float(i + j + k) for k in range(3)] for j in range(i % 4)]]
+            for i in range(40)
+        ]
+        out = str(sandbox / "seq")
+        DatasetWriter(
+            out, schema,
+            TFRecordOptions.from_map(recordType="SequenceExample"),
+            mode="overwrite", max_records_per_file=20,
+        ).write_rows(rows)
+        cdir = str(sandbox / "seqcache")
+        kw = dict(recordType="SequenceExample")
+        base = collect(out, schema=schema, **kw)
+        collect(out, schema=schema, cache="auto", cache_dir=cdir, **kw)
+        METRICS.reset()
+        served = collect(out, schema=schema, cache="auto", cache_dir=cdir, **kw)
+        assert served == base and METRICS.counter("cache.hits") == 2
+
+    def test_partitioned_dataset_cached(self, sandbox):
+        schema = StructType(
+            [
+                StructField("id", LongType(), nullable=False),
+                StructField("part", StringType(), nullable=False),
+            ]
+        )
+        rows = [[i, f"p{i % 2}"] for i in range(40)]
+        out = str(sandbox / "parts")
+        tfio.write(rows, schema, out, mode="overwrite", partition_by=["part"])
+        cdir = str(sandbox / "pcache")
+        base = collect(out, schema=schema)
+        collect(out, schema=schema, cache="auto", cache_dir=cdir)
+        METRICS.reset()
+        served = collect(out, schema=schema, cache="auto", cache_dir=cdir)
+        assert served == base and METRICS.counter("cache.hits") > 0
+
+    def test_parallel_workers_and_shuffle_window(self, data_dir, cache_dir):
+        base = collect(data_dir, num_workers=3)
+        collect(data_dir, cache="auto", cache_dir=cache_dir)
+        served = collect(data_dir, num_workers=3, cache="auto", cache_dir=cache_dir)
+        assert served == base
+        shuf_u = collect(data_dir, shuffle=True, seed=5, shuffle_window=2)
+        shuf_c = collect(
+            data_dir, shuffle=True, seed=5, shuffle_window=2,
+            cache="auto", cache_dir=cache_dir,
+        )
+        assert shuf_u == shuf_c
+
+
+class TestInvalidation:
+    def _shards(self, data_dir):
+        return sorted(
+            os.path.join(data_dir, n)
+            for n in os.listdir(data_dir)
+            if n.startswith("part-")
+        )
+
+    def _populate(self, data_dir, cache_dir, **kw):
+        collect(data_dir, cache="auto", cache_dir=cache_dir, **kw)
+        METRICS.reset()
+
+    def test_mtime_change_misses(self, data_dir, cache_dir):
+        self._populate(data_dir, cache_dir)
+        os.utime(self._shards(data_dir)[0], (12345, 12345))
+        served = collect(data_dir, cache="auto", cache_dir=cache_dir)
+        assert METRICS.counter("cache.hits") == 2
+        assert METRICS.counter("cache.misses") == 1
+        assert served == collect(data_dir)
+        # the touched shard was repopulated: everything hits again
+        METRICS.reset()
+        collect(data_dir, cache="auto", cache_dir=cache_dir)
+        assert METRICS.counter("cache.hits") == 3
+
+    def test_size_change_misses_and_serves_new_rows(self, data_dir, cache_dir):
+        self._populate(data_dir, cache_dir)
+        victim = self._shards(data_dir)[0]
+        recs = list(wire.read_records(victim))
+        wire.write_records(victim, recs + [recs[0]])  # one extra record
+        served = collect(data_dir, cache="auto", cache_dir=cache_dir)
+        assert METRICS.counter("cache.misses") == 1
+        assert served == collect(data_dir)
+        assert len(served) == len(ROWS) + 1
+
+    def test_schema_change_misses(self, data_dir, cache_dir):
+        self._populate(data_dir, cache_dir)
+        collect(data_dir, columns=["id", "arr"], cache="auto", cache_dir=cache_dir)
+        assert METRICS.counter("cache.hits") == 0
+        assert METRICS.counter("cache.misses") == 3
+        # both fingerprints now coexist as separate entries
+        assert len(entries_in(cache_dir)) == 6
+
+    def test_verify_crc_change_misses(self, data_dir, cache_dir):
+        self._populate(data_dir, cache_dir)
+        collect(data_dir, verify_crc=False, cache="auto", cache_dir=cache_dir)
+        assert METRICS.counter("cache.hits") == 0
+
+    def test_irrelevant_option_change_hits(self, data_dir, cache_dir):
+        self._populate(data_dir, cache_dir)
+        ds = TFRecordDataset(
+            data_dir, batch_size=17, schema=SCHEMA, drop_remainder=False,
+            num_epochs=1, num_workers=2, prefetch=7, use_mmap=False,
+            readahead_bytes=0, slab_bytes=1 << 20, read_retries=2,
+            cache="auto", cache_dir=cache_dir,
+        )
+        got = []
+        with ds.batches() as it:
+            for b in it:
+                got.extend(batch_to_rows(b, ds.schema))
+        assert got == collect(data_dir)
+        assert METRICS.counter("cache.hits") == 3
+        assert METRICS.counter("cache.misses") == 0
+
+    def test_container_version_bump_misses(self, data_dir, cache_dir, monkeypatch):
+        self._populate(data_dir, cache_dir)
+        monkeypatch.setattr(cache_mod, "VERSION", cache_mod.VERSION + 1)
+        served = collect(data_dir, cache="auto", cache_dir=cache_dir)
+        assert METRICS.counter("cache.hits") == 0
+        assert METRICS.counter("cache.misses") == 3
+        assert served == collect(data_dir)
+
+    def test_tolerant_corrupt_policy_disables_cache(self, data_dir, cache_dir):
+        got = collect(
+            data_dir, on_corrupt="skip_record", cache="auto", cache_dir=cache_dir
+        )
+        assert got == collect(data_dir)
+        assert entries_in(cache_dir) == []
+
+
+class TestCorruptFallback:
+    def _flip_section_byte(self, entry_path, which=0):
+        footer = cache_mod.load_footer(entry_path)
+        sec = footer["chunks"][0]["columns"][which]["sections"][0][1]
+        raw = bytearray(open(entry_path, "rb").read())
+        raw[sec["off"]] ^= 0xFF
+        open(entry_path, "wb").write(bytes(raw))
+
+    def test_flipped_section_byte_falls_back(self, data_dir, cache_dir):
+        base = collect(data_dir)
+        collect(data_dir, cache="auto", cache_dir=cache_dir)
+        self._flip_section_byte(entries_in(cache_dir)[0])
+        METRICS.reset()
+        served = collect(data_dir, cache="auto", cache_dir=cache_dir)
+        assert served == base
+        assert METRICS.counter("cache.corrupt_fallbacks") == 1
+        assert METRICS.counter("cache.hits") == 2
+        # the corrupt entry was rewritten in place: clean hits afterwards
+        METRICS.reset()
+        assert collect(data_dir, cache="auto", cache_dir=cache_dir) == base
+        assert METRICS.counter("cache.hits") == 3
+        assert METRICS.counter("cache.corrupt_fallbacks") == 0
+
+    def test_truncated_entry_falls_back(self, data_dir, cache_dir):
+        base = collect(data_dir)
+        collect(data_dir, cache="auto", cache_dir=cache_dir)
+        entry = entries_in(cache_dir)[0]
+        raw = open(entry, "rb").read()
+        open(entry, "wb").write(raw[: len(raw) // 2])
+        METRICS.reset()
+        assert collect(data_dir, cache="auto", cache_dir=cache_dir) == base
+        assert METRICS.counter("cache.corrupt_fallbacks") == 1
+
+    def test_corrupt_footer_falls_back(self, data_dir, cache_dir):
+        base = collect(data_dir)
+        collect(data_dir, cache="auto", cache_dir=cache_dir)
+        entry = entries_in(cache_dir)[0]
+        raw = bytearray(open(entry, "rb").read())
+        raw[-30] ^= 0xFF  # inside the footer JSON / tail
+        open(entry, "wb").write(bytes(raw))
+        METRICS.reset()
+        assert collect(data_dir, cache="auto", cache_dir=cache_dir) == base
+        assert METRICS.counter("cache.corrupt_fallbacks") == 1
+
+    def test_corrupt_source_is_not_cached(self, data_dir, cache_dir):
+        victim = self._corrupt_source_shard(data_dir)
+        with pytest.raises(wire.TFRecordCorruptionError):
+            collect(data_dir, cache="auto", cache_dir=cache_dir)
+        # the failed shard's staging was aborted: no committed entry for it,
+        # and no staging litter left behind
+        fp = cache_mod.decode_fingerprint(
+            TFRecordDataset(
+                data_dir, batch_size=8, schema=SCHEMA, cache="auto",
+                cache_dir=cache_dir,
+            )._cache_ident()
+        )
+        bad = os.path.join(cache_dir, cache_mod.entry_filename(victim, fp))
+        assert not os.path.exists(bad)
+        temp_root = os.path.join(cache_dir, "_temporary")
+        assert not os.path.isdir(temp_root) or os.listdir(temp_root) == []
+
+    def _corrupt_source_shard(self, data_dir):
+        victim = sorted(
+            os.path.join(data_dir, n)
+            for n in os.listdir(data_dir)
+            if n.startswith("part-")
+        )[0]
+        raw = bytearray(open(victim, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        open(victim, "wb").write(bytes(raw))
+        return victim
+
+
+class TestResumeInterchange:
+    def _state_after(self, data_dir, n_batches, **kw):
+        ds = TFRecordDataset(
+            data_dir, batch_size=8, schema=SCHEMA, drop_remainder=False,
+            num_epochs=1, **kw,
+        )
+        it = ds.batches()
+        head = []
+        for _ in range(n_batches):
+            head.extend(batch_to_rows(next(it), ds.schema))
+        state = it.state()
+        it.close()
+        return head, state
+
+    @pytest.mark.parametrize("n_batches", [2, 5])  # mid-shard and cross-shard
+    def test_saved_uncached_restored_cached(self, data_dir, cache_dir, n_batches):
+        head, state = self._state_after(data_dir, n_batches)
+        rest_uncached = collect(data_dir, state=state)
+        collect(data_dir, cache="auto", cache_dir=cache_dir)  # populate
+        METRICS.reset()
+        rest_cached = collect(data_dir, state=state, cache="auto", cache_dir=cache_dir)
+        assert rest_cached == rest_uncached
+        assert head + rest_cached == collect(data_dir)
+        assert METRICS.counter("cache.hits") > 0
+
+    def test_saved_cached_restored_uncached(self, data_dir, cache_dir):
+        collect(data_dir, cache="auto", cache_dir=cache_dir)  # populate
+        head, state = self._state_after(
+            data_dir, 5, cache="auto", cache_dir=cache_dir
+        )
+        rest = collect(data_dir, state=state)
+        assert head + rest == collect(data_dir)
+
+    def test_resume_miss_does_not_populate_partial_entry(self, data_dir, cache_dir):
+        # a mid-shard resume with no entry decodes a SUFFIX: caching it
+        # would freeze a partial shard — assert nothing was committed for
+        # the straddled shard, then a fresh full pass populates all three
+        _head, state = self._state_after(data_dir, 2)  # mid shard 0
+        assert state.record_offset > 0
+        collect(data_dir, state=state, cache="auto", cache_dir=cache_dir)
+        assert len(entries_in(cache_dir)) == 2  # shards 1, 2 only
+        collect(data_dir, cache="auto", cache_dir=cache_dir)
+        assert len(entries_in(cache_dir)) == 3
+
+
+class TestEvictionAndHygiene:
+    def test_lru_eviction_respects_budget(self, data_dir, cache_dir):
+        METRICS.reset()
+        collect(data_dir, cache="auto", cache_dir=cache_dir, cache_max_bytes=1)
+        # budget of 1 byte: every commit sweeps earlier entries; the
+        # just-committed one is protected, so exactly one survives
+        assert len(entries_in(cache_dir)) == 1
+        assert METRICS.counter("cache.evictions") == 2
+        # correctness unaffected: the evicted shards just decode again
+        assert (
+            collect(data_dir, cache="auto", cache_dir=cache_dir, cache_max_bytes=1)
+            == collect(data_dir)
+        )
+
+    def test_unbounded_by_default(self, data_dir, cache_dir):
+        METRICS.reset()
+        collect(data_dir, cache="auto", cache_dir=cache_dir)
+        assert METRICS.counter("cache.evictions") == 0
+        assert len(entries_in(cache_dir)) == 3
+
+    def test_chaos_open_fault_on_cache_falls_back(self, data_dir, cache_dir):
+        base = collect(data_dir)
+        collect(data_dir, cache="auto", cache_dir=cache_dir)
+        plan = FaultPlan(
+            [FaultRule(op="open", kind="transient_error", path=cache_mod.ENTRY_SUFFIX,
+                       times=None)],
+            seed=7,
+        )
+        METRICS.reset()
+        with install_chaos(plan):
+            served = collect(data_dir, cache="auto", cache_dir=cache_dir)
+        plan.release()
+        assert served == base
+        assert METRICS.counter("cache.hits") == 0  # every open faulted -> miss
+        assert any(e["op"] == "open" for e in plan.ledger)
+        # after the fault clears, the (rewritten) entries serve again
+        METRICS.reset()
+        assert collect(data_dir, cache="auto", cache_dir=cache_dir) == base
+        assert METRICS.counter("cache.hits") == 3
+
+
+class TestRegistryAndRemote:
+    def test_scheme_cache_dir_rejected(self, data_dir):
+        with pytest.raises(ValueError, match="cache_dir must be a local path"):
+            TFRecordDataset(
+                data_dir, batch_size=8, schema=SCHEMA,
+                cache="auto", cache_dir="memory://nope/cache",
+            )
+
+    def test_registry_skips_reverification_across_datasets(
+        self, data_dir, cache_dir, monkeypatch
+    ):
+        collect(data_dir, cache="auto", cache_dir=cache_dir)  # populate
+        collect(data_dir, cache="auto", cache_dir=cache_dir)  # verify+register
+        calls = []
+        orig = cache_mod.open_entry_file
+
+        def spy(*a, **kw):
+            calls.append(a)
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(cache_mod, "open_entry_file", spy)
+        METRICS.reset()
+        served = collect(data_dir, cache="auto", cache_dir=cache_dir)
+        assert served == collect(data_dir)
+        assert METRICS.counter("cache.hits") == 3
+        assert calls == []  # full verification paid once per process, not per dataset
+
+    def test_registry_prunes_superseded_generations(self, data_dir, cache_dir):
+        collect(data_dir, cache="auto", cache_dir=cache_dir)
+        collect(data_dir, cache="auto", cache_dir=cache_dir)  # register gen 1
+        victim = sorted(
+            os.path.join(data_dir, n)
+            for n in os.listdir(data_dir)
+            if n.startswith("part-")
+        )[0]
+        os.utime(victim, (777, 777))  # stale -> repopulate (gen 2, new inode)
+        collect(data_dir, cache="auto", cache_dir=cache_dir)
+        collect(data_dir, cache="auto", cache_dir=cache_dir)  # register gen 2
+        for entry in entries_in(cache_dir):
+            apath = os.path.abspath(entry)
+            gens = [k for k in cache_mod._ENTRY_REGISTRY if k[0] == apath]
+            assert len(gens) <= 1, gens  # old generation's mmap unpinned
+
+    def test_in_place_flip_same_inode_size_still_detected(self, data_dir, cache_dir):
+        # an in-place rewrite keeps inode AND size; mtime in the registry
+        # key is what forces re-verification (and the corrupt fallback)
+        base = collect(data_dir)
+        collect(data_dir, cache="auto", cache_dir=cache_dir)
+        collect(data_dir, cache="auto", cache_dir=cache_dir)  # register entries
+        entry = entries_in(cache_dir)[0]
+        footer = cache_mod.load_footer(entry)
+        off = footer["chunks"][0]["columns"][0]["sections"][0][1]["off"]
+        raw = bytearray(open(entry, "rb").read())
+        raw[off] ^= 0xFF
+        with open(entry, "r+b") as fh:  # same inode, same size
+            fh.write(bytes(raw))
+        METRICS.reset()
+        assert collect(data_dir, cache="auto", cache_dir=cache_dir) == base
+        assert METRICS.counter("cache.corrupt_fallbacks") == 1
+
+    def test_remote_same_size_rewrite_invalidates(self, sandbox):
+        pytest.importorskip("fsspec")
+        schema = StructType([StructField("id", LongType(), nullable=False)])
+        src = "memory://tfr-cache-test/ds"
+        cdir = str(sandbox / "rcache")
+        tfio.write([[i] for i in range(20)], schema, src, mode="overwrite")
+        first = collect(src, schema=schema, cache="auto", cache_dir=cdir)
+        assert first == collect(src, schema=schema, cache="auto", cache_dir=cdir)
+        # rewrite with DIFFERENT rows but identical byte length
+        tfio.write([[i + 100] for i in range(20)], schema, src, mode="overwrite")
+        METRICS.reset()
+        served = collect(src, schema=schema, cache="auto", cache_dir=cdir)
+        assert [r[0] for r in served] == [i + 100 for i in range(20)]
+        assert METRICS.counter("cache.misses") >= 1  # stale, not served
+
+    def test_failed_populator_setup_leaves_no_staging(
+        self, data_dir, cache_dir, monkeypatch
+    ):
+        from tpu_tfrecord.cache import CachePopulator, ShardCache
+
+        cache = ShardCache(cache_dir, ident={"x": 1})
+
+        class MissingShard:
+            path = os.path.join(data_dir, "does-not-exist.tfrecord")
+            size = 0
+
+        assert cache.populator(MissingShard()) is None  # os.stat fails
+
+        # a failure AFTER the staging dir exists must remove it — the
+        # marker names a live pid, so the orphan sweep never would
+        def boom(self):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(CachePopulator, "_write_marker", boom)
+
+        class RealShard:
+            path = sorted(
+                os.path.join(data_dir, n)
+                for n in os.listdir(data_dir)
+                if n.startswith("part-")
+            )[0]
+            size = os.path.getsize(path)
+
+        assert cache.populator(RealShard()) is None
+        temp_root = os.path.join(cache_dir, "_temporary")
+        assert not os.path.isdir(temp_root) or os.listdir(temp_root) == []
+
+
+def _rewrite_footer(entry_path, mutate):
+    """Re-author an entry's footer with a VALID CRC — the 'malformed but
+    CRC-consistent metadata' producer-bug class."""
+    import struct
+
+    raw = bytearray(open(entry_path, "rb").read())
+    (flen,) = struct.unpack("<Q", raw[-20:-12])
+    footer = json.loads(raw[-20 - flen : -20].decode("utf-8"))
+    mutate(footer)
+    blob = json.dumps(footer, sort_keys=True, default=str).encode("utf-8")
+    tail = struct.pack("<QI8s", len(blob), wire.crc32c(blob), cache_mod.TAIL_MAGIC)
+    open(entry_path, "wb").write(bytes(raw[: -20 - flen]) + blob + tail)
+
+
+class TestMalformedFooter:
+    def test_missing_chunks_falls_back(self, data_dir, cache_dir):
+        base = collect(data_dir)
+        collect(data_dir, cache="auto", cache_dir=cache_dir)
+        _rewrite_footer(entries_in(cache_dir)[0], lambda f: f.pop("chunks"))
+        METRICS.reset()
+        assert collect(data_dir, cache="auto", cache_dir=cache_dir) == base
+        assert METRICS.counter("cache.corrupt_fallbacks") == 1
+
+    def test_inconsistent_section_geometry_falls_back(self, data_dir, cache_dir):
+        base = collect(data_dir)
+        collect(data_dir, cache="auto", cache_dir=cache_dir)
+
+        def bad_dtype(footer):
+            sec = footer["chunks"][0]["columns"][0]["sections"][0][1]
+            sec["dtype"] = "<i3"  # unparseable: view() would raise at serve
+
+        _rewrite_footer(entries_in(cache_dir)[0], bad_dtype)
+        METRICS.reset()
+        assert collect(data_dir, cache="auto", cache_dir=cache_dir) == base
+        assert METRICS.counter("cache.corrupt_fallbacks") == 1
+
+    def test_unexpected_column_name_falls_back(self, data_dir, cache_dir):
+        base = collect(data_dir)
+        collect(data_dir, cache="auto", cache_dir=cache_dir)
+
+        def rename_column(footer):
+            footer["chunks"][0]["columns"][0]["name"] = "not_in_schema"
+
+        _rewrite_footer(entries_in(cache_dir)[0], rename_column)
+        METRICS.reset()
+        assert collect(data_dir, cache="auto", cache_dir=cache_dir) == base
+        assert METRICS.counter("cache.corrupt_fallbacks") == 1
+
+    def test_row_count_mismatch_falls_back(self, data_dir, cache_dir):
+        base = collect(data_dir)
+        collect(data_dir, cache="auto", cache_dir=cache_dir)
+
+        def lie_about_rows(footer):
+            footer["chunks"][0]["num_rows"] += 1  # sections cover one fewer
+
+        _rewrite_footer(entries_in(cache_dir)[0], lie_about_rows)
+        METRICS.reset()
+        assert collect(data_dir, cache="auto", cache_dir=cache_dir) == base
+        assert METRICS.counter("cache.corrupt_fallbacks") == 1
+
+    def test_release_registry_unpins_cache_dir(self, data_dir, cache_dir):
+        collect(data_dir, cache="auto", cache_dir=cache_dir)
+        collect(data_dir, cache="auto", cache_dir=cache_dir)  # register
+        prefix = os.path.abspath(cache_dir) + os.sep
+        assert any(k[0].startswith(prefix) for k in cache_mod._ENTRY_REGISTRY)
+        n = cache_mod.release_registry(cache_dir)
+        assert n == 3
+        assert not any(k[0].startswith(prefix) for k in cache_mod._ENTRY_REGISTRY)
+
+    def test_doctor_reports_malformed_footer_without_crashing(
+        self, data_dir, cache_dir, capsys
+    ):
+        collect(data_dir, cache="auto", cache_dir=cache_dir)
+        _rewrite_footer(entries_in(cache_dir)[0], lambda f: f.pop("chunks"))
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "tfrecord_doctor_malformed_test",
+            os.path.join(root, "tools", "tfrecord_doctor.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        rc = mod.main(["cache", cache_dir])
+        lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+        statuses = [l["status"] for l in lines if l["event"] == "cache_entry"]
+        assert rc == 1 and statuses.count("corrupt") == 1
+
+
+class TestDoctorCacheSubcommand:
+    def _doctor(self):
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "tfrecord_doctor_cache_test",
+            os.path.join(root, "tools", "tfrecord_doctor.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_list_verify_and_evict_stale(self, data_dir, cache_dir, capsys):
+        doctor = self._doctor()
+        collect(data_dir, cache="auto", cache_dir=cache_dir)
+        rc = doctor.main(["cache", cache_dir])
+        lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+        entries = [l for l in lines if l["event"] == "cache_entry"]
+        summary = [l for l in lines if l["event"] == "cache_summary"][0]
+        assert rc == 0 and len(entries) == 3 and summary["status_ok"] == 3
+        assert all(e["crc_verified"] and e["rows"] == PER_SHARD for e in entries)
+        # stale one source shard; --evict-stale drops exactly its entry
+        victim = sorted(
+            n for n in os.listdir(data_dir) if n.startswith("part-")
+        )[0]
+        os.utime(os.path.join(data_dir, victim), (1, 1))
+        rc = doctor.main(["cache", "--evict-stale", cache_dir])
+        lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+        summary = [l for l in lines if l["event"] == "cache_summary"][0]
+        assert rc == 1 and summary["status_stale"] == 1 and summary["evicted"] == 1
+        assert len(entries_in(cache_dir)) == 2
+
+    def test_corrupt_entry_reported_not_evicted(self, data_dir, cache_dir, capsys):
+        doctor = self._doctor()
+        collect(data_dir, cache="auto", cache_dir=cache_dir)
+        entry = entries_in(cache_dir)[0]
+        footer = cache_mod.load_footer(entry)
+        off = footer["chunks"][0]["columns"][0]["sections"][0][1]["off"]
+        raw = bytearray(open(entry, "rb").read())
+        raw[off] ^= 0xFF
+        open(entry, "wb").write(bytes(raw))
+        rc = doctor.main(["cache", "--evict-stale", cache_dir])
+        lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+        corrupt = [l for l in lines if l.get("status") == "corrupt"]
+        assert rc == 1 and len(corrupt) == 1
+        assert os.path.exists(entry)  # kept for inspection
+        rc = doctor.main(["cache", "--evict-stale", "--evict-corrupt", cache_dir])
+        capsys.readouterr()
+        assert rc == 1 and not os.path.exists(entry)

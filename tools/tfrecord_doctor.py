@@ -13,6 +13,16 @@ Usage::
     tools/tfrecord_doctor.py --repair bad.tfrecord        # + salvage copy
     tools/tfrecord_doctor.py --repair --out fixed.tfrecord bad.tfrecord
     tools/tfrecord_doctor.py --simulate plan.json shard   # chaos repro
+    tools/tfrecord_doctor.py cache CACHE_DIR              # epoch-cache audit
+    tools/tfrecord_doctor.py cache --evict-stale CACHE_DIR
+
+The ``cache`` subcommand audits a columnar epoch cache directory
+(tpu_tfrecord.cache): one ``{"event": "cache_entry", ...}`` line per entry
+with its fingerprint, source shard, size, chunk/row counts, and CRC-verify
+status (``ok`` | ``stale`` | ``corrupt`` | ``source_missing``);
+``--evict-stale`` deletes entries whose source shard changed or vanished
+(corrupt entries are reported but kept for inspection unless
+``--evict-corrupt`` is also given).
 
 ``--simulate plan.json`` replays a deterministic fault plan
 (tpu_tfrecord.faults.FaultPlan JSON) against the scan — the repro half of
@@ -130,7 +140,79 @@ def expand_paths(inputs: List[str]) -> List[str]:
     return out
 
 
+def cache_main(argv: List[str]) -> int:
+    """The ``cache`` subcommand: audit (and optionally prune) a columnar
+    epoch cache directory. Exit 0 = every entry ok; 1 = stale/corrupt/
+    orphaned entries found (evicted ones still count); 2 = unreadable dir."""
+    from tpu_tfrecord import cache as cache_mod
+
+    ap = argparse.ArgumentParser(
+        prog="tfrecord_doctor cache",
+        description="List/verify columnar epoch cache entries",
+    )
+    ap.add_argument("cache_dirs", nargs="+", help="cache directories")
+    ap.add_argument(
+        "--evict-stale", action="store_true",
+        help="delete entries whose source shard changed or vanished",
+    )
+    ap.add_argument(
+        "--evict-corrupt", action="store_true",
+        help="with --evict-stale semantics for CRC-corrupt entries too",
+    )
+    args = ap.parse_args(argv)
+
+    def emit(obj: Dict) -> None:
+        sys.stdout.write(json.dumps(obj, sort_keys=True) + "\n")
+
+    rc = 0
+    for cache_dir in args.cache_dirs:
+        if not os.path.isdir(cache_dir):
+            emit({"event": "error", "path": cache_dir, "error": "not a directory"})
+            rc = 2
+            continue
+        counts: Dict[str, int] = {}
+        evicted = 0
+        try:
+            # materialized up front: an unreadable dir must exit 2, not
+            # read as a healthy empty cache
+            reports = list(cache_mod.iter_entry_reports(cache_dir))
+        except OSError as e:
+            emit({"event": "error", "path": cache_dir, "error": str(e)})
+            rc = 2
+            continue
+        for report in reports:
+            status = report["status"]
+            counts[status] = counts.get(status, 0) + 1
+            drop = (
+                args.evict_stale and status in ("stale", "source_missing")
+            ) or (args.evict_corrupt and status == "corrupt")
+            if drop:
+                try:
+                    os.remove(report["entry"])
+                    report = dict(report, evicted=True)
+                    evicted += 1
+                except OSError as e:
+                    report = dict(report, evicted=False, evict_error=str(e))
+            emit({"event": "cache_entry", **report})
+        emit(
+            {
+                "event": "cache_summary",
+                "path": cache_dir,
+                "entries": sum(counts.values()),
+                "evicted": evicted,
+                **{f"status_{k}": v for k, v in sorted(counts.items())},
+            }
+        )
+        if rc == 0 and any(k != "ok" for k in counts):
+            rc = 1
+    return rc
+
+
 def main(argv: Optional[List[str]] = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "cache":
+        return cache_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="tfrecord_doctor", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,

@@ -83,6 +83,56 @@ print("chaos smoke OK:", json.dumps({
 }))
 PY
 
+echo "== cache smoke (populate -> mmap-served epoch -> corrupt fallback) =="
+# Write a dataset, run two epochs with cache="auto", assert the second
+# (cache-served) epoch's rows are byte-identical with cache.hits > 0, then
+# flip one byte inside a cache section and assert exactly one
+# cache.corrupt_fallbacks with ground-truth rows — so the epoch cache
+# can't rot.
+env JAX_PLATFORMS=cpu python - <<'PY' || exit 1
+import json, os, tempfile
+
+import tpu_tfrecord.io as tfio
+from tpu_tfrecord import cache as cache_mod
+from tpu_tfrecord.columnar import batch_to_rows
+from tpu_tfrecord.io.dataset import TFRecordDataset
+from tpu_tfrecord.metrics import METRICS
+from tpu_tfrecord.schema import LongType, StringType, StructField, StructType
+
+schema = StructType([StructField("id", LongType(), nullable=False),
+                     StructField("s", StringType())])
+base = tempfile.mkdtemp(prefix="tfr_cache_smoke_")
+out = os.path.join(base, "ds"); cdir = os.path.join(base, "cache")
+tfio.write([[i, f"s{i}"] for i in range(60)], schema, out, mode="overwrite")
+
+def epoch_rows():
+    ds = TFRecordDataset(out, batch_size=7, schema=schema, drop_remainder=False,
+                         cache="auto", cache_dir=cdir)
+    with ds.batches() as it:
+        return [r for b in it for r in batch_to_rows(b, ds.schema)]
+
+METRICS.reset()
+ep1 = epoch_rows()          # populate
+ep2 = epoch_rows()          # mmap-served
+assert ep1 == ep2 and len(ep1) == 60, "epoch-2 rows differ from epoch-1"
+assert METRICS.counter("cache.hits") > 0, "no cache hit on epoch 2"
+entry = [os.path.join(cdir, n) for n in os.listdir(cdir)
+         if n.endswith(cache_mod.ENTRY_SUFFIX)][0]
+off = cache_mod.load_footer(entry)["chunks"][0]["columns"][0]["sections"][0][1]["off"]
+raw = bytearray(open(entry, "rb").read()); raw[off] ^= 0xFF
+open(entry, "wb").write(bytes(raw))
+METRICS.reset()
+ep3 = epoch_rows()          # corrupt entry -> ground-truth decode + rewrite
+assert ep3 == ep1, "corrupt-cache fallback rows differ from ground truth"
+assert METRICS.counter("cache.corrupt_fallbacks") == 1, \
+    METRICS.counter("cache.corrupt_fallbacks")
+print("cache smoke OK:", json.dumps({
+    "rows": len(ep3),
+    "hits": METRICS.counter("cache.hits"),
+    "corrupt_fallbacks": METRICS.counter("cache.corrupt_fallbacks"),
+}))
+PY
+
 echo "== tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log

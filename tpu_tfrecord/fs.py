@@ -186,6 +186,12 @@ class FsspecFS:
     def size(self, path: str) -> int:
         return self._fs.size(self._strip(path))
 
+    def info(self, path: str) -> dict:
+        """Backend metadata dict (size plus whatever freshness stamp the
+        store exposes — mtime / LastModified / ETag); the epoch cache keys
+        remote-source invalidation on it (tpu_tfrecord.cache.source_stat)."""
+        return self._fs.info(self._strip(path))
+
     def glob(self, pattern: str) -> List[str]:
         return sorted(
             self._unstrip(p) for p in self._fs.glob(self._strip(pattern))

@@ -263,6 +263,49 @@ class TFRecordDataset:
         # difference between IO-bound and decode-bound cold ingest
         # (BASELINE.md configs[4], "read at line rate").
         self.readahead_bytes = max(0, readahead_bytes)
+        # Columnar epoch cache (tpu_tfrecord.cache): the first pass over a
+        # shard appends its decoded chunks to a per-shard entry; later
+        # epochs — and later runs with the same decode fingerprint — serve
+        # zero-copy mmap views instead of re-decoding, turning warm epochs
+        # from CPU-bound into page-cache-bound. Engaged only under the
+        # strict corruption policy: tolerant policies can legally emit
+        # fewer rows than the shard holds, and caching a salvaged subset
+        # would freeze one corruption outcome into later epochs.
+        self._cache = None
+        if self.options.cache == "auto":
+            if self.options.on_corrupt != "raise":
+                from tpu_tfrecord.metrics import logger as _logger
+
+                _logger.warning(
+                    "tfrecord.cache disabled: cache='auto' requires "
+                    "on_corrupt='raise' (got %r)", self.options.on_corrupt,
+                )
+            else:
+                from tpu_tfrecord import cache as _cache_mod
+
+                # the exact column set a decoded chunk carries: data
+                # columns (minus pack members when the native fused decode
+                # folds them into group matrices) + group names +
+                # requested partition fields
+                fused = self._native_decoder is not None
+                members = (
+                    {m for ms in self.pack.values() for m in ms} if fused else set()
+                )
+                expect = (
+                    {f.name for f in self._data_schema if f.name not in members}
+                    | (set(self.pack) if fused else set())
+                    | {f.name for f in self._partition_fields}
+                )
+                self._cache = _cache_mod.ShardCache(
+                    self.options.cache_dir or _cache_mod.default_cache_dir(),
+                    ident=self._cache_ident(),
+                    max_bytes=self.options.cache_max_bytes,
+                    expect_columns=expect,
+                )
+                dtypes = {f.name: f.data_type for f in self.schema}
+                for gname, members_ in self.pack.items():
+                    dtypes[gname] = self._data_schema[members_[0]].data_type
+                self._cache_dtypes = dtypes
 
     # -- chunked decode stream with positional accounting --------------------
     #
@@ -380,18 +423,75 @@ class TFRecordDataset:
                 METRICS.count("read.retries")
 
     def _decode_shard(self, epoch: int, pos: int, shard_idx: int, skip: int) -> Iterator[tuple]:
-        """Decode one shard into chunk tuples, applying ``on_corrupt`` (via
+        """Decode one shard into chunk tuples, applying the epoch cache
+        (serve-on-hit / populate-on-miss), ``on_corrupt`` (via
         ``_decode_shard_inner``) and then ``on_stall``: a stall that
         escaped the transient retries (a DeadlineError from the stall
         guard) either propagates (``"raise"``, the default) or drops the
         rest of this shard with the same deterministic skipped-shard
         accounting corruption uses (``"skip_shard"``)."""
         try:
-            yield from self._decode_shard_inner(epoch, pos, shard_idx, skip)
+            if self._cache is not None:
+                yield from self._decode_shard_caching(epoch, pos, shard_idx, skip)
+            else:
+                yield from self._decode_shard_inner(epoch, pos, shard_idx, skip)
         except StallError as e:
             if self.options.on_stall != "skip_shard":
                 raise
             self._note_skipped_shard(shard_idx, str(e), kind="shard_stalled")
+
+    def _decode_shard_caching(
+        self, epoch: int, pos: int, shard_idx: int, skip: int
+    ) -> Iterator[tuple]:
+        """The cache layer around one shard's decode: a validated entry
+        serves mmap-backed chunks; a miss decodes from the TFRecord source
+        and (on a fresh, full pass) appends each chunk to a staging entry
+        committed atomically at shard end. Any mid-decode exception —
+        including GeneratorExit from an abandoned iterator — aborts the
+        staging entry, so only complete shards are ever cached."""
+        shard = self.shards[shard_idx]
+        entry = self._cache.open_entry(shard)
+        if entry is not None:
+            yield from self._serve_cached(entry, epoch, pos, shard_idx, skip)
+            return
+        # resume mid-shard (skip > 0) decodes a suffix only: populating
+        # would cache a partial entry, so it stays a plain decode
+        pop = self._cache.populator(shard) if skip == 0 else None
+        if pop is None:
+            yield from self._decode_shard_inner(epoch, pos, shard_idx, skip)
+            return
+        try:
+            for item in self._decode_shard_inner(epoch, pos, shard_idx, 0):
+                pop.append(item[0], item[3])
+                yield item
+        except BaseException:
+            pop.abort()
+            raise
+        pop.commit()
+
+    def _serve_cached(
+        self, entry, epoch: int, pos: int, shard_idx: int, skip: int
+    ) -> Iterator[tuple]:
+        """Yield a cached shard's chunk tuples from the resume point. Chunk
+        boundaries are the ones recorded at populate time (the fresh-pass
+        decode boundaries), and record indices are absolute within the
+        shard — so IteratorState checkpoints resume interchangeably between
+        cached and uncached reads; a mid-chunk resume slices the straddling
+        chunk exactly like the decode paths start mid-slab."""
+        from tpu_tfrecord.tracing import trace
+
+        dtype_of = self._cache_dtypes.__getitem__
+        for i in range(entry.num_chunks):
+            start, n = entry.chunk_span(i)
+            if n == 0 or start + n <= skip:
+                continue
+            with timed("cache.serve", METRICS) as t, trace("tfr:cache"):
+                chunk = entry.chunk_batch(i, dtype_of)
+                if skip > start:
+                    chunk = slice_batch(chunk, skip - start, chunk.num_rows)
+                    start = skip
+                t.records += chunk.num_rows
+            yield chunk, epoch, pos, start
 
     def _decode_shard_inner(
         self, epoch: int, pos: int, shard_idx: int, skip: int
@@ -751,6 +851,30 @@ class TFRecordDataset:
             chunk.columns[f.name] = col
 
     # -- identity ------------------------------------------------------------
+
+    def _cache_ident(self) -> Dict[str, Any]:
+        """Everything that changes decoded chunk CONTENT, for the epoch
+        cache's decode fingerprint (tpu_tfrecord.cache.decode_fingerprint):
+        the physical data schema, requested partition fields, record type,
+        the hash/pack decode fusions, CRC verification, and the
+        record-size cap. Options that only change how chunks are produced
+        (batch_size, workers, prefetch, mmap, readahead, retries,
+        deadlines) are excluded so changing them still hits."""
+        ident: Dict[str, Any] = {
+            "schema": self._data_schema.to_json(),
+            "partition_fields": [f.name for f in self._partition_fields],
+            "record_type": self.options.record_type.value,
+            "hash_buckets": self.hash_buckets,
+            "pack": self.pack,
+            "verify_crc": self.options.verify_crc,
+            "max_record_bytes": self.max_record_bytes,
+        }
+        if self.hash_buckets or self.pack:
+            # hash/pack fusion only happens in the native decoder: chunks
+            # produced with vs without it carry different columns, so the
+            # environments must not share entries
+            ident["fused"] = self._native_decoder is not None
+        return ident
 
     def fingerprint(self) -> str:
         """Digest of everything a resume position depends on: the GLOBAL

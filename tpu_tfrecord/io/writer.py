@@ -294,6 +294,22 @@ _HEARTBEAT_INTERVAL = 60.0
 _LEASE_TTL = 3600.0
 
 
+def job_marker_payload(task_id: int = 0, created: Optional[float] = None) -> bytes:
+    """The ``_JOB_META`` liveness-marker JSON (pid/host/created/heartbeat)
+    that ``sweep_orphan_jobs`` parses — ONE owner for the schema, shared by
+    write jobs and cache populates (tpu_tfrecord.cache)."""
+    now = time.time()
+    return json.dumps(
+        {
+            "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "created": created if created is not None else now,
+            "heartbeat": now,
+            "task_id": task_id,
+        }
+    ).encode("utf-8")
+
+
 def _pid_alive(pid: int) -> bool:
     try:
         os.kill(pid, 0)
@@ -411,17 +427,7 @@ class _WriteJob:
     def _write_marker(self) -> None:
         try:
             with self.fs.open(os.path.join(self.temp_root, _JOB_MARKER), "wb") as fh:
-                fh.write(
-                    json.dumps(
-                        {
-                            "pid": os.getpid(),
-                            "host": socket.gethostname(),
-                            "created": self._created,
-                            "heartbeat": time.time(),
-                            "task_id": self.task_id,
-                        }
-                    ).encode("utf-8")
-                )
+                fh.write(job_marker_payload(self.task_id, created=self._created))
         except OSError:
             pass  # marker is best-effort: its absence only disables sweeping
 

@@ -57,9 +57,12 @@ class Metrics:
         """Increment a pure event counter (the ``records`` field carries the
         count). Used by the robustness counters: ``read.corrupt_records``,
         ``read.resyncs``, ``read.retries``, ``read.skipped_shards``,
-        ``write.commit_retries``, and the stall counters (``read.stalls``,
+        ``write.commit_retries``, the stall counters (``read.stalls``,
         ``read.deadline_misses``, ``read.hedges``, ``read.hedge_wins``,
-        ``read.watchdog_restarts``).
+        ``read.watchdog_restarts``), and the epoch-cache counters
+        (``cache.hits``, ``cache.misses``, ``cache.bytes_written``,
+        ``cache.evictions``, ``cache.corrupt_fallbacks`` — mmap-served
+        chunk throughput lands in the ``cache.serve`` stage).
 
         Thread-safety audit (counters are bumped from prefetch workers,
         stall-guard workers, the watchdog, and writer pipeline threads):

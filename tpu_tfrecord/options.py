@@ -12,6 +12,7 @@ role of the reference's SerializableConfiguration (DefaultSource.scala:145-182)
 from __future__ import annotations
 
 import enum
+import os
 from dataclasses import dataclass, replace
 from typing import Any, Dict, Mapping, Optional
 
@@ -99,6 +100,20 @@ class TFRecordOptions:
         (handled per ``on_stall``) and a replacement worker is spawned
         (``read.watchdog_restarts``) so the rest of the epoch keeps
         decoding instead of blocking on the dead worker's queue forever.
+      - cache: columnar epoch cache mode. ``"off"`` (default) decodes
+        every epoch; ``"auto"`` appends each shard's decoded chunks to a
+        per-shard cache entry on the first pass and serves later epochs
+        (and later runs with the same decode fingerprint) as zero-copy
+        mmap views — no frame parse, no CRC, no protobuf decode. A
+        corrupt or stale entry falls back to the ground-truth TFRecord
+        decode and is rewritten (tpu_tfrecord.cache).
+      - cache_dir: where cache entries live (default: a per-USER
+        directory under the system temp dir — uid-suffixed so one user's
+        predictable entry names cannot be pre-staged by another). Must be
+        a LOCAL path — the serve path mmaps entry files.
+      - cache_max_bytes: LRU budget for ``cache_dir`` (None = unbounded);
+        oldest-unused entries are evicted after each populate commit
+        (``cache.evictions``).
     """
 
     record_type: RecordType = RecordType.EXAMPLE
@@ -118,6 +133,9 @@ class TFRecordOptions:
     hedge_after_ms: Optional[float] = None
     on_stall: str = "raise"
     watchdog_timeout_ms: Optional[float] = None
+    cache: str = "off"
+    cache_dir: Optional[str] = None
+    cache_max_bytes: Optional[int] = None
 
     _KNOWN_KEYS = (
         "recordType",
@@ -152,11 +170,17 @@ class TFRecordOptions:
         "onStall",
         "watchdog_timeout_ms",
         "watchdogTimeoutMs",
+        "cache",
+        "cache_dir",
+        "cacheDir",
+        "cache_max_bytes",
+        "cacheMaxBytes",
     )
 
     ON_CORRUPT_POLICIES = ("raise", "skip_record", "skip_shard")
     CORRUPT_FALLBACKS = ("raise", "skip_shard")
     ON_STALL_POLICIES = ("raise", "skip_shard")
+    CACHE_MODES = ("off", "auto")
 
     @staticmethod
     def from_map(options: Optional[Mapping[str, Any]] = None, **kwargs: Any) -> "TFRecordOptions":
@@ -247,6 +271,22 @@ class TFRecordOptions:
                 f"on_stall must be one of {TFRecordOptions.ON_STALL_POLICIES}, "
                 f"got {on_stall!r}"
             )
+        cache = str(merged.pop("cache", "off") or "off").strip().lower()
+        if cache not in TFRecordOptions.CACHE_MODES:
+            raise ValueError(
+                f"cache must be one of {TFRecordOptions.CACHE_MODES}, "
+                f"got {cache!r}"
+            )
+        cache_dir = merged.pop("cache_dir", merged.pop("cacheDir", None))
+        if cache_dir is not None:
+            cache_dir = os.fspath(cache_dir)
+        cache_max_bytes = merged.pop(
+            "cache_max_bytes", merged.pop("cacheMaxBytes", None)
+        )
+        if cache_max_bytes is not None:
+            cache_max_bytes = int(cache_max_bytes)
+            if cache_max_bytes < 1:
+                raise ValueError("cache_max_bytes must be >= 1 (or None)")
         if merged:
             import difflib
 
@@ -280,6 +320,9 @@ class TFRecordOptions:
             hedge_after_ms=hedge_after_ms,
             on_stall=on_stall,
             watchdog_timeout_ms=watchdog_timeout_ms,
+            cache=cache,
+            cache_dir=cache_dir,
+            cache_max_bytes=cache_max_bytes,
         )
 
     def with_schema(self, schema: StructType) -> "TFRecordOptions":
