@@ -114,9 +114,12 @@ def parallel_scaling_probe() -> float:
     return serial / dual
 
 
-def run_once(schema, batches, out_dir, codec, workers, num_shards):
+def run_once(schema, batches, out_dir, codec, workers, num_shards, trace="off"):
     """One full write_batches job (encode + frame + compress + commit);
-    returns (examples/sec, METRICS 'write' family snapshot)."""
+    returns (examples/sec, METRICS 'write' family snapshot, occupancy).
+    ``occupancy`` is the slab pipeline's in-flight fill EMA (None for the
+    sequential path) — telemetry.boundness_verdict reads it as
+    committer-bound (high) vs encode-bound (low)."""
     from tpu_tfrecord.io.writer import DatasetWriter
     from tpu_tfrecord.metrics import METRICS
     from tpu_tfrecord.options import TFRecordOptions
@@ -125,6 +128,7 @@ def run_once(schema, batches, out_dir, codec, workers, num_shards):
         codec=None if codec in (None, "none") else codec,
         write_workers=workers,
         num_shards=num_shards,
+        trace=trace,
     )
     n_examples = sum(b.num_rows for b in batches)
     METRICS.reset()
@@ -133,26 +137,56 @@ def run_once(schema, batches, out_dir, codec, workers, num_shards):
     writer.write_batches(batches)
     rate = n_examples / (time.perf_counter() - t0)
     stages = METRICS.snapshot("write")
+    occupancy = METRICS.gauge_value("write.occupancy")
     shutil.rmtree(out_dir, ignore_errors=True)
-    return rate, stages
+    return rate, stages, occupancy
 
 
 def measure_pair(schema, batches, out_dir, codec):
     """Interleaved best-of-REPS for sequential vs parallel under the same
-    ambient load; returns (seq_best, par_best, par_best_stages)."""
+    ambient load; returns (seq_best, par_best, par_best_stages, par_occ)."""
     run_once(schema, batches, out_dir, codec, 1, None)  # warm both paths
     run_once(schema, batches, out_dir, codec, WORKERS, SHARDS)
-    seq_best, par_best, par_stages = 0.0, 0.0, {}
+    seq_best, par_best, par_stages, par_occ = 0.0, 0.0, {}, None
     for _ in range(REPS):
-        seq, _ = run_once(schema, batches, out_dir, codec, 1, None)
-        par, stages = run_once(schema, batches, out_dir, codec, WORKERS, SHARDS)
+        seq, _, _ = run_once(schema, batches, out_dir, codec, 1, None)
+        par, stages, occ = run_once(
+            schema, batches, out_dir, codec, WORKERS, SHARDS
+        )
         seq_best = max(seq_best, seq)
         if par > par_best:
-            par_best, par_stages = par, stages
-    return seq_best, par_best, par_stages
+            par_best, par_stages, par_occ = par, stages, occ
+    return seq_best, par_best, par_stages, par_occ
+
+
+def tracing_overhead(schema, batches, out_dir, codec):
+    """Flight-recorder overhead on the parallel write path: interleaved
+    trace-off/trace-on reps, best-of-each (one-sided noise — same argument
+    as the read bench). Returns the overhead pct (negative = in the
+    noise)."""
+    from tpu_tfrecord import telemetry as tm
+
+    off_best, on_best = 0.0, 0.0
+    for r in range(REPS):
+        order = (("off",), ("on",)) if r % 2 == 0 else (("on",), ("off",))
+        for (mode,) in order:
+            if mode == "on":
+                tm.RECORDER.clear()
+            rate, _, _ = run_once(
+                schema, batches, out_dir, codec, WORKERS, SHARDS, trace=mode
+            )
+            tm.disable()
+            if mode == "on":
+                on_best = max(on_best, rate)
+            else:
+                off_best = max(off_best, rate)
+    tm.RECORDER.clear()
+    return round((1.0 - on_best / off_best) * 100.0, 2) if off_best else None
 
 
 def main() -> None:
+    from tpu_tfrecord.telemetry import boundness_verdict, quantiles_ms
+
     schema = criteo_schema()
     batches = make_batches(schema)
     work_dir = os.environ.get("TFR_BENCH_WRITE_DIR") or tempfile.mkdtemp(
@@ -160,13 +194,20 @@ def main() -> None:
     )
     out_dir = os.path.join(work_dir, "out")
     probe = parallel_scaling_probe()
-    results, breakdowns = {}, {}
+    results, breakdowns, quantiles, occupancies = {}, {}, {}, {}
     for codec in ("none", "zlib"):
-        seq, par, stages = measure_pair(schema, batches, out_dir, codec)
+        seq, par, stages, occ = measure_pair(schema, batches, out_dir, codec)
         results[codec] = (seq, par)
+        # gauges share the snapshot namespace with distinct shapes — only
+        # stage entries carry "seconds"
         breakdowns[codec] = {
-            name: round(st["seconds"], 3) for name, st in sorted(stages.items())
+            name: round(st["seconds"], 3)
+            for name, st in sorted(stages.items())
+            if "seconds" in st
         }
+        quantiles[codec] = quantiles_ms(stages)
+        occupancies[codec] = occ
+    trace_pct = tracing_overhead(schema, batches, out_dir, "zlib")
     shutil.rmtree(work_dir, ignore_errors=True)
 
     headline = {"": "none", "none": "none", "zlib": "zlib", "deflate": "zlib"}.get(
@@ -216,6 +257,21 @@ def main() -> None:
         # across threads, so encode+compress can exceed the job wall time —
         # that overlap is the point)
         "breakdown_seconds": breakdowns[headline],
+        # flight-recorder A/B on the parallel path (ISSUE 5 acceptance:
+        # <= 2%; negative = in the noise)
+        "tracing_overhead_pct": trace_pct,
+        # per-stage latency quantiles (always-on histograms) + the write
+        # pipeline's bound-ness: "consumer_bound" = the committer (IO) is
+        # the bottleneck, "producer_bound" = encode/planner is
+        "telemetry": {
+            "quantiles": quantiles[headline],
+            "write_occupancy": (
+                round(occupancies[headline], 4)
+                if occupancies[headline] is not None
+                else None
+            ),
+            "verdict": boundness_verdict(occupancies[headline]),
+        },
     }
     print(json.dumps(out))
 

@@ -173,7 +173,13 @@ def main() -> None:
     print(f"done: {step} steps, {step * BATCH / dt:,.0f} examples/s")
     if duty.value() is not None:
         print(f"device duty cycle: {duty.value():.1%} (target >=95%)")
-    print("stage throughput:", {k: round(v["records_per_sec"]) for k, v in METRICS.snapshot().items() if v["records"]})
+    # gauges share the snapshot namespace with a distinct {"gauge": v}
+    # shape — only stage entries carry records/records_per_sec
+    print("stage throughput:", {
+        k: round(v["records_per_sec"])
+        for k, v in METRICS.snapshot().items()
+        if v.get("records")
+    })
 
 
 if __name__ == "__main__":

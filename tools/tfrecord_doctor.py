@@ -15,6 +15,18 @@ Usage::
     tools/tfrecord_doctor.py --simulate plan.json shard   # chaos repro
     tools/tfrecord_doctor.py cache CACHE_DIR              # epoch-cache audit
     tools/tfrecord_doctor.py cache --evict-stale CACHE_DIR
+    tools/tfrecord_doctor.py report DATA_DIR              # bottleneck doctor
+
+The ``report`` subcommand is the bottleneck doctor: it runs N batches of
+the real pipeline with the flight recorder on (tpu_tfrecord.telemetry)
+and prints where the time went — one ``{"event": "stage", ...}`` line per
+pipeline stage (seconds, records, p50/p99 latency), one
+``{"event": "shard", ...}`` line per slowest shard (span-attributed
+seconds), and a final ``{"event": "report", ...}`` line with the
+straggler ratio (decode p99/p50) and the producer/consumer bound-ness
+verdict — "is this pipeline decode-bound or is the consumer the
+bottleneck?" answered without attaching a profiler. ``--trace-out
+FILE.json`` additionally saves the Chrome trace (open in Perfetto).
 
 The ``cache`` subcommand audits a columnar epoch cache directory
 (tpu_tfrecord.cache): one ``{"event": "cache_entry", ...}`` line per entry
@@ -208,11 +220,153 @@ def cache_main(argv: List[str]) -> int:
     return rc
 
 
+def report_main(argv: List[str]) -> int:
+    """The ``report`` subcommand: run N batches with tracing on and print
+    the stage breakdown, slowest shards, straggler ratio, and the
+    bound-ness verdict. Exit 0 = report produced (slow is not an error);
+    2 = the dataset could not be read at all."""
+    ap = argparse.ArgumentParser(
+        prog="tfrecord_doctor report",
+        description="Bottleneck doctor: trace a real read and explain it",
+    )
+    ap.add_argument("data_dir", help="dataset directory (or shard glob)")
+    ap.add_argument(
+        "--batches", type=int, default=32,
+        help="batches to run before reporting (default 32)",
+    )
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument(
+        "--workers", type=int, default=1,
+        help="parallel decode workers (num_workers) for the probe read",
+    )
+    ap.add_argument(
+        "--top", type=int, default=5,
+        help="slowest shards to report (default 5)",
+    )
+    ap.add_argument(
+        "--trace-out", default=None, metavar="FILE",
+        help="save the Chrome trace-event JSON here (open in Perfetto)",
+    )
+    args = ap.parse_args(argv)
+
+    from tpu_tfrecord import telemetry
+    from tpu_tfrecord.io.dataset import TFRecordDataset
+    from tpu_tfrecord.metrics import METRICS
+
+    def emit(obj: Dict) -> None:
+        sys.stdout.write(json.dumps(obj, sort_keys=True) + "\n")
+
+    METRICS.reset()
+    telemetry.RECORDER.clear()
+    rows = 0
+    batches = 0
+    try:
+        # --batches epochs is enough to fill --batches batches on ANY
+        # non-empty dataset (each epoch yields >= 1 batch with
+        # drop_remainder=False) while still TERMINATING on a dataset whose
+        # shards hold zero records — num_epochs=None would spin forever
+        # there, and the doctor must always exit
+        ds = TFRecordDataset(
+            args.data_dir,
+            batch_size=args.batch_size,
+            num_workers=args.workers,
+            drop_remainder=False,
+            num_epochs=max(1, args.batches),
+            trace="on",
+        )
+        with ds.batches() as it:
+            for cb in it:
+                rows += cb.num_rows
+                batches += 1
+                if batches >= args.batches:
+                    break
+    except Exception as e:  # unreadable dataset, not a slow one
+        emit({"event": "error", "path": args.data_dir, "error": str(e)})
+        return 2
+    finally:
+        telemetry.disable()
+
+    for name, entry in sorted(METRICS.snapshot().items()):
+        if not entry.get("seconds"):
+            # gauges (no "seconds" key) land in the final line; pure
+            # count()-style counters (seconds == 0.0) are not pipeline
+            # stages — they are already the report's "counters" map
+            continue
+        line: Dict = {
+            "event": "stage",
+            "stage": name,
+            "seconds": round(entry["seconds"], 6),
+            "records": int(entry["records"]),
+        }
+        ms = telemetry.quantiles_ms({name: entry}).get(name)
+        if ms:
+            line.update({k: v for k, v in ms.items() if k != "count"})
+        emit(line)
+
+    # span-attributed per-shard time: which shards the pipeline actually
+    # spent its open/read/decode/serve time on (stragglers by name)
+    per_shard: Dict[str, Dict] = {}
+    for name, _t0, dur, _tid, attrs, ph in telemetry.RECORDER.spans():
+        shard = (attrs or {}).get("shard")
+        if ph != "X" or shard is None:
+            continue
+        agg = per_shard.setdefault(shard, {"seconds": 0.0, "spans": 0})
+        agg["seconds"] += dur / 1e9
+        agg["spans"] += 1
+    ranked = sorted(
+        per_shard.items(), key=lambda kv: kv[1]["seconds"], reverse=True
+    )
+    for path, agg in ranked[: args.top]:
+        emit(
+            {
+                "event": "shard",
+                "path": path,
+                "seconds": round(agg["seconds"], 6),
+                "spans": agg["spans"],
+            }
+        )
+
+    q = METRICS.quantiles().get("decode") or {}
+    straggler = (
+        round(q["p99_s"] / q["p50_s"], 2) if q.get("p50_s") else None
+    )
+    occupancy = METRICS.gauge_value(telemetry.OCCUPANCY_GAUGE)
+    report = {
+        "event": "report",
+        "path": args.data_dir,
+        "batches": batches,
+        "rows": rows,
+        # decode straggler spread: p99/p50 chunk latency (1.x = uniform;
+        # >>1 = a few chunks/shards dominate — look at the shard lines)
+        "straggler_p99_p50": straggler,
+        "prefetch_occupancy": (
+            round(occupancy, 4) if occupancy is not None else None
+        ),
+        "verdict": telemetry.boundness_verdict(occupancy),
+        "counters": {
+            name: int(totals[0])
+            for name, totals in sorted(METRICS.raw_totals().items())
+            if totals[3] == 0.0 and totals[1] == 0
+        },
+        "spans_recorded": len(telemetry.RECORDER),
+        "spans_dropped": telemetry.RECORDER.dropped,
+    }
+    if ranked:
+        report["slowest_shard"] = ranked[0][0]
+    if args.trace_out is not None:
+        telemetry.RECORDER.save_chrome_trace(args.trace_out)
+        report["trace_path"] = args.trace_out
+    emit(report)
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "cache":
         return cache_main(argv[1:])
+    if argv and argv[0] == "report":
+        return report_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="tfrecord_doctor", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,

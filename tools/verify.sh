@@ -133,6 +133,55 @@ print("cache smoke OK:", json.dumps({
 }))
 PY
 
+echo "== telemetry smoke (trace -> Chrome trace + pulse + doctor report) =="
+# One traced read end-to-end: the exported trace parses and contains decode
+# spans, one pulse line parses, and the bottleneck doctor exits 0 with a
+# verdict — so the flight recorder can't rot. All device-free, < 2s.
+env JAX_PLATFORMS=cpu python - <<'PY' || exit 1
+import json, os, subprocess, sys, tempfile
+
+import tpu_tfrecord.io as tfio
+from tpu_tfrecord import telemetry
+from tpu_tfrecord.io.dataset import TFRecordDataset
+from tpu_tfrecord.metrics import METRICS
+from tpu_tfrecord.schema import LongType, StructField, StructType
+from tpu_tfrecord.telemetry import Pulse
+
+schema = StructType([StructField("id", LongType(), nullable=False)])
+out = os.path.join(tempfile.mkdtemp(prefix="tfr_tele_smoke_"), "ds")
+tfio.write([[i] for i in range(120)], schema, out, mode="overwrite")
+
+METRICS.reset(); telemetry.RECORDER.clear()
+pulses = []
+pulse = Pulse(0.05, emit=pulses.append).start()
+ds = TFRecordDataset(out, batch_size=16, schema=schema, drop_remainder=False,
+                     trace="on")
+with ds.batches() as it:
+    rows = sum(b.num_rows for b in it)
+pulse.stop()  # final tick guarantees at least one line
+telemetry.disable()
+assert rows == 120, rows
+trace = json.loads(json.dumps(telemetry.RECORDER.to_chrome_trace()))
+decode = [e for e in trace["traceEvents"] if e["name"] == "decode"]
+assert decode, "no decode spans in exported trace"
+assert all("ts" in e and "dur" in e for e in decode), decode[0]
+line = json.loads(json.dumps(pulses[-1]))
+assert line["event"] == "pulse" and "verdict" in line, line
+
+doc = subprocess.run([sys.executable, "tools/tfrecord_doctor.py", "report",
+                      out, "--batches", "4", "--batch-size", "16"],
+                     capture_output=True, text=True)
+assert doc.returncode == 0, (doc.returncode, doc.stdout, doc.stderr)
+lines = [json.loads(l) for l in doc.stdout.splitlines() if l.strip()]
+report = [l for l in lines if l.get("event") == "report"][0]
+assert report.get("verdict"), report
+print("telemetry smoke OK:", json.dumps({
+    "decode_spans": len(decode),
+    "pulse_lines": len(pulses),
+    "doctor_verdict": report["verdict"],
+}))
+PY
+
 echo "== tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log

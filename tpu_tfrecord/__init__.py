@@ -24,6 +24,9 @@ A from-scratch re-design of the capabilities of linkedin/spark-tfrecord
   pipeline watchdog and the on_stall policy                -> `tpu_tfrecord.stall`
 - Deterministic chaos-FS fault injection (seeded FaultPlan + ChaosFS with
   a replayable fault ledger)                               -> `tpu_tfrecord.faults`
+- Pipeline flight recorder: span tracing (Chrome-trace export), latency
+  histograms, the telemetry pulse + Prometheus endpoint, and the
+  producer/consumer bound-ness verdict                     -> `tpu_tfrecord.telemetry`
 """
 
 from tpu_tfrecord.schema import (

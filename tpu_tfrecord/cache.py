@@ -54,15 +54,16 @@ import os
 import struct
 import tempfile
 import threading
+import time
 import uuid
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
-from tpu_tfrecord import fs as _fs, wire
+from tpu_tfrecord import fs as _fs, telemetry, wire
 from tpu_tfrecord.columnar import Column, ColumnarBatch
 from tpu_tfrecord.io import paths as p
-from tpu_tfrecord.metrics import METRICS, logger
+from tpu_tfrecord.metrics import METRICS, logger, timed
 
 MAGIC = b"TFRCACH1"
 TAIL_MAGIC = b"TFRCEND1"
@@ -462,6 +463,7 @@ class CachePopulator:
     def __init__(self, cache: "ShardCache", shard_path: str, source: Dict[str, Any]):
         self._cache = cache
         self._source = source
+        self.source_path = shard_path
         self.final_path = os.path.join(
             cache.cache_dir, entry_filename(shard_path, cache.fingerprint)
         )
@@ -563,6 +565,11 @@ class CachePopulator:
         LRU sweep. Returns True when the entry landed."""
         if self._dead:
             return False
+        with timed("cache.commit", METRICS), \
+                telemetry.span("cache.commit", shard=self.source_path):
+            return self._commit_inner()
+
+    def _commit_inner(self) -> bool:
         try:
             footer = {
                 "version": VERSION,
@@ -799,12 +806,25 @@ class ShardCache:
                 if entry is not None:
                     _registry_drop_path(path)  # superseded: unpin its mmap
                 entry = None
-            entry = open_entry_file(
-                path,
-                expect_fingerprint=self.fingerprint,
-                source=source,
-                expect_columns=self.expect_columns,
-            )
+            # the once-per-process full section verification: worth a
+            # latency histogram of its own — a slow first epoch on a big
+            # cache is usually THIS, not decode. Timed by hand, NOT via
+            # ``timed``: a routine cold miss raises CacheOpenError here,
+            # and the error-counting exit would report cache.open.errors
+            # on every perfectly healthy first epoch (the span still
+            # self-marks failed=1, which a trace reader wants to see)
+            _t0 = time.perf_counter()
+            try:
+                with telemetry.span("cache.open", shard=shard.path):
+                    entry = open_entry_file(
+                        path,
+                        expect_fingerprint=self.fingerprint,
+                        source=source,
+                        expect_columns=self.expect_columns,
+                    )
+            finally:
+                _dt = time.perf_counter() - _t0
+                METRICS.add("cache.open", seconds=_dt, latency=_dt)
             if key is not None:
                 _registry_put(key, entry)
         except CacheOpenError as e:

@@ -42,8 +42,10 @@ from tpu_tfrecord.io.reader import (
     CorruptQuotaError,
     DatasetReader,
     SalvageTracker,
+    _timed_open,
     salvage_spans_stream,
 )
+from tpu_tfrecord import telemetry
 from tpu_tfrecord.metrics import METRICS, log_salvage_event, timed
 from tpu_tfrecord.options import TFRecordOptions
 from tpu_tfrecord.retry import RetryPolicy
@@ -181,6 +183,16 @@ class TFRecordDataset:
             else DatasetReader(paths, **option_kwargs)
         )
         self.options = self._reader.options
+        # Flight recorder opt-in (tpu_tfrecord.telemetry): the recorder is
+        # process-global (spans come from prefetch workers, the stall
+        # guard, and writer threads on one shared timeline), so any
+        # dataset built with trace="on" switches it on; trace="off"
+        # deliberately does NOT switch it off — another live dataset may
+        # be tracing.
+        if self.options.trace == "on":
+            telemetry.enable()
+        if self.options.telemetry_port is not None:
+            telemetry.ensure_exporter(self.options.telemetry_port)
         self.batch_size = batch_size
         self.drop_remainder = drop_remainder
         self.num_epochs = num_epochs
@@ -421,6 +433,7 @@ class TFRecordDataset:
                 if not pol.pause(attempt, start):
                     raise
                 METRICS.count("read.retries")
+                telemetry.instant("read.retry", attempt=attempt)
 
     def _decode_shard(self, epoch: int, pos: int, shard_idx: int, skip: int) -> Iterator[tuple]:
         """Decode one shard into chunk tuples, applying the epoch cache
@@ -481,16 +494,19 @@ class TFRecordDataset:
         from tpu_tfrecord.tracing import trace
 
         dtype_of = self._cache_dtypes.__getitem__
+        shard_path = self.shards[shard_idx].path
         for i in range(entry.num_chunks):
             start, n = entry.chunk_span(i)
             if n == 0 or start + n <= skip:
                 continue
-            with timed("cache.serve", METRICS) as t, trace("tfr:cache"):
+            with timed("cache.serve", METRICS) as t, trace("tfr:cache"), \
+                    telemetry.span("cache.serve", shard=shard_path) as sp:
                 chunk = entry.chunk_batch(i, dtype_of)
                 if skip > start:
                     chunk = slice_batch(chunk, skip - start, chunk.num_rows)
                     start = skip
                 t.records += chunk.num_rows
+                sp.set(rows=chunk.num_rows)
             yield chunk, epoch, pos, start
 
     def _decode_shard_inner(
@@ -546,6 +562,7 @@ class TFRecordDataset:
         from tpu_tfrecord.tracing import trace
 
         chunk_records = max(self.batch_size, 2048)
+        shard_path = self.shards[shard_idx].path
         base = 0
         for buf, offsets, lengths in slabs:
             n = len(offsets)
@@ -554,12 +571,14 @@ class TFRecordDataset:
                 continue
             for start in range(max(0, next_index[0] - base), n, chunk_records):
                 stop = min(start + chunk_records, n)
-                with timed("decode", METRICS) as t, trace("tfr:decode"):
+                with timed("decode", METRICS) as t, trace("tfr:decode"), \
+                        telemetry.span("decode", shard=shard_path) as sp:
                     chunk = self._decode_chunk(
                         buf, offsets[start:stop], lengths[start:stop]
                     )
                     t.records += chunk.num_rows
                     t.bytes += int(lengths[start:stop].sum())
+                    sp.set(rows=chunk.num_rows)
                 if self._partition_fields:
                     self._attach_partition_chunk(chunk, shard_idx)
                 yield chunk, epoch, pos, base + start
@@ -641,14 +660,19 @@ class TFRecordDataset:
                 grown[:tail_len] = buf[:tail_len]
                 scratch["buf"] = buf = grown
         reader = getattr(fh, "readinto", None)
-        if reader is not None:
-            n = reader(memoryview(buf)[tail_len:])
-        else:
-            # file-like without readinto (wrappers, remote FS objects):
-            # one extra copy, same contract
-            data = fh.read(buf.nbytes - tail_len)
-            n = len(data)
-            buf[tail_len : tail_len + n] = np.frombuffer(data, np.uint8)
+        t0 = time.perf_counter()
+        with telemetry.span("read", shard=path) as sp:
+            if reader is not None:
+                n = reader(memoryview(buf)[tail_len:])
+            else:
+                # file-like without readinto (wrappers, remote FS objects):
+                # one extra copy, same contract
+                data = fh.read(buf.nbytes - tail_len)
+                n = len(data)
+                buf[tail_len : tail_len + n] = np.frombuffer(data, np.uint8)
+            sp.set(bytes=int(n or 0))
+        dt = time.perf_counter() - t0
+        METRICS.add("read.io", nbytes=int(n or 0), seconds=dt, latency=dt)
         if not n:
             if tail_len:
                 raise self._truncated_error(path)
@@ -673,17 +697,19 @@ class TFRecordDataset:
         verify = self.options.verify_crc
         shard = self.shards[shard_idx]
 
-        def attempt() -> Iterator[tuple]:
+        def raw_open(path: str, _codec) -> Any:
             # the open runs under the open deadline when configured (mmap
             # READS are page-cache memory — the open is the only stallable
             # filesystem op on this path); _open_local resolves at call
             # time so the chaos injector's patch is honored
             if self._stall_guard is not None:
-                opened = self._stall_guard.call_open(
-                    lambda: _open_local(shard.path, "rb"), shard.path
+                return self._stall_guard.call_open(
+                    lambda: _open_local(path, "rb"), path
                 )
-            else:
-                opened = _open_local(shard.path, "rb")
+            return _open_local(path, "rb")
+
+        def attempt() -> Iterator[tuple]:
+            opened = _timed_open(raw_open, shard.path, None)
             with opened as fh:
                 size = os.fstat(fh.fileno()).st_size
                 if size == 0:
@@ -697,7 +723,8 @@ class TFRecordDataset:
                     bpos = 0
                     while True:
                         hint(bpos)
-                        with timed("decode", METRICS) as t, trace("tfr:decode"):
+                        with timed("decode", METRICS) as t, trace("tfr:decode"), \
+                                telemetry.span("decode", shard=shard.path) as sp:
                             cb, n_sk, n_done, consumed = dec.scan_decode(
                                 buf, bpos, verify, to_skip, chunk_records,
                                 length=size,
@@ -705,6 +732,7 @@ class TFRecordDataset:
                             )
                             t.records += n_done
                             t.bytes += consumed - bpos
+                            sp.set(rows=n_done)
                         to_skip -= n_sk
                         abs_idx += n_sk
                         bpos = consumed
@@ -755,13 +783,12 @@ class TFRecordDataset:
         verify = self.options.verify_crc
         scratch = self._io_scratch()
 
+        open_fn = self._guarded_open_fn() or (
+            lambda p, c: wire.open_compressed(p, "rb", c)
+        )
+
         def attempt() -> Iterator[tuple]:
-            opener = (
-                (lambda: self._stall_guard.open_compressed(shard.path, codec))
-                if self._stall_guard is not None
-                else (lambda: wire.open_compressed(shard.path, "rb", codec))
-            )
-            with opener() as fh:
+            with _timed_open(open_fn, shard.path, codec) as fh:
                 # Readahead for local shards: hint by the wrapper's
                 # tell() each refill. For codecs tell() is the DECODED
                 # offset, which overshoots the raw offset — that only
@@ -794,7 +821,8 @@ class TFRecordDataset:
                     buf = scratch["buf"]
                     bpos = 0
                     while True:
-                        with timed("decode", METRICS) as t, trace("tfr:decode"):
+                        with timed("decode", METRICS) as t, trace("tfr:decode"), \
+                                telemetry.span("decode", shard=shard.path) as sp:
                             cb, n_sk, n_done, consumed = dec.scan_decode(
                                 buf, bpos, verify, to_skip, chunk_records,
                                 length=data_len,
@@ -802,6 +830,7 @@ class TFRecordDataset:
                             )
                             t.records += n_done
                             t.bytes += consumed - bpos
+                            sp.set(rows=n_done)
                         to_skip -= n_sk
                         abs_idx += n_sk
                         bpos = consumed
@@ -960,11 +989,17 @@ def _producer_loop(
                 if entry[1] >= chunk.num_rows:
                     pending.pop(0)
             batch = concat_batches(slices)
+        blocked = False
         while not stop.is_set():
             try:
                 out_queue.put((batch, end_pos), timeout=0.1)
                 return True
             except queue.Full:
+                if not blocked:
+                    # the consumer is behind (queue full): one count per
+                    # blocked put, not per 100ms poll
+                    blocked = True
+                    METRICS.count("read.backpressure_waits")
                 continue
         return False
 
@@ -1022,11 +1057,15 @@ def _shuffled_producer_loop(
     target = ds.shuffle_window * B
 
     def put(batch, pos) -> bool:
+        blocked = False
         while not stop.is_set():
             try:
                 out_queue.put((batch, pos), timeout=0.1)
                 return True
             except queue.Full:
+                if not blocked:
+                    blocked = True
+                    METRICS.count("read.backpressure_waits")
                 continue
         return False
 
@@ -1198,6 +1237,7 @@ def _parallel_chunks(
             job.beat = clock()
             with inflight_lock:
                 inflight[id(job)] = job
+                METRICS.gauge("read.inflight_workers", len(inflight))
             try:
                 try:
                     for item in ds._decode_shard(*job.task):
@@ -1218,6 +1258,7 @@ def _parallel_chunks(
             finally:
                 with inflight_lock:
                     inflight.pop(id(job), None)
+                    METRICS.gauge("read.inflight_workers", len(inflight))
 
     def watchdog() -> None:
         interval = max(0.01, wd_timeout / 4.0)
@@ -1243,6 +1284,7 @@ def _parallel_chunks(
                 )
                 METRICS.count("read.stalls")
                 METRICS.count("read.watchdog_restarts")
+                telemetry.instant("watchdog_restart", path=path)
                 log_salvage_event(
                     path=path, kind="watchdog_restart", error=str(job.failed)
                 )
@@ -1301,6 +1343,21 @@ class CheckpointableIterator:
         self._finished = None  # None=running, True=exhausted, Exception=failed
         self._queue: queue.Queue = queue.Queue(maxsize=max(1, dataset.prefetch))
         self._stop = threading.Event()
+        # Bound-ness telemetry: EMA of the prefetch queue's fill fraction,
+        # sampled by the consumer at each batch get (telemetry.Pulse reads
+        # the gauge; boundness_verdict interprets it).
+        self._occupancy = telemetry.OccupancyEma(telemetry.OCCUPANCY_GAUGE)
+        self._pulse = None
+        if dataset.options.pulse_interval_s is not None:
+            from tpu_tfrecord.telemetry import Pulse
+
+            self._pulse = Pulse(dataset.options.pulse_interval_s).start()
+            # like the stop-event finalizer below: an abandoned iterator
+            # must not leave its pulse thread ticking forever (the
+            # finalizer holds the Pulse, never this object)
+            self._pulse_finalizer = weakref.finalize(
+                self, Pulse.stop, self._pulse, False
+            )
         # If the iterator is abandoned without close() (no with-block, early
         # break, GC after an error), the finalizer trips the stop event so
         # producer/dispatcher/worker threads exit and shard buffers free.
@@ -1321,12 +1378,22 @@ class CheckpointableIterator:
     def __next__(self) -> ColumnarBatch:
         if self._finished is not None:
             raise self._finished if not isinstance(self._finished, bool) else StopIteration
+        # Bound-ness sample BEFORE blocking: the queue's fill fraction as
+        # the consumer arrives is the signal — full = producer keeps ahead
+        # (consumer-bound), empty = the consumer is waiting on decode
+        # (producer-bound).
+        q = self._queue
+        depth = q.qsize()
+        self._occupancy.update(depth / q.maxsize)
+        METRICS.gauge("prefetch.queue_depth", depth)
+        t0_ns = time.perf_counter_ns()
         while True:
             if self._stop.is_set():
                 # close()d: iteration is over — the producer exits without
                 # enqueuing its None sentinel, so never block forever (and a
                 # batch racing into the queue during close() is not yielded).
                 self._finished = True
+                self._stop_pulse()
                 raise StopIteration
             try:
                 item = self._queue.get(timeout=0.1)
@@ -1336,14 +1403,32 @@ class CheckpointableIterator:
         if item is None:
             self._finished = True
             self._stop.set()  # let any lingering pipeline threads exit
+            self._stop_pulse()
             raise StopIteration
         if isinstance(item, BaseException):
             self._finished = item
             self._stop.set()
+            self._stop_pulse()
             raise item
         batch, end_pos = item
+        wait_ns = time.perf_counter_ns() - t0_ns
+        wait_s = wait_ns / 1e9
+        METRICS.add(
+            "batch.wait", records=batch.num_rows, seconds=wait_s, latency=wait_s
+        )
+        telemetry.record_span("batch", t0_ns, wait_ns, rows=batch.num_rows)
         self._consumed_state = end_pos
         return batch
+
+    def _stop_pulse(self) -> None:
+        """Stop the telemetry pulse at end of iteration (exhausted, failed,
+        or closed); the final tick covers the tail interval."""
+        pulse, self._pulse = self._pulse, None
+        if pulse is not None:
+            try:
+                pulse.stop()
+            except Exception:
+                pass
 
     def state(self) -> IteratorState:
         """Resume position of the last batch YIELDED, stamped with the
@@ -1355,6 +1440,7 @@ class CheckpointableIterator:
         # interpreter shutdown (an abandoned iterator collected late), when
         # module globals — including our `queue` import — are already None.
         self._stop.set()
+        self._stop_pulse()
         # Drain so the producer unblocks and exits.
         try:
             while True:

@@ -21,15 +21,40 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
-from tpu_tfrecord import wire
+from tpu_tfrecord import telemetry, wire
 from tpu_tfrecord.infer import infer_from_records, merge_type_maps, type_map_to_schema
 from tpu_tfrecord.io import paths as p
 from tpu_tfrecord.io.paths import Shard
-from tpu_tfrecord.metrics import METRICS, log_salvage_event
+from tpu_tfrecord.metrics import METRICS, log_salvage_event, timed
 from tpu_tfrecord.options import RecordType, TFRecordOptions
 from tpu_tfrecord.schema import StructField, StructType
 from tpu_tfrecord.serde import Row, TFRecordDeserializer, decode_record
 from tpu_tfrecord.stall import StallError, guard_from_options
+from tpu_tfrecord.tracing import trace
+
+
+def _timed_open(open_fn, path: str, codec):
+    """One owner for the shard-open instrumentation every span stream pays:
+    the open's latency lands in the ``read.open`` histogram (shard opens
+    are a classic straggler source on object stores) and, when the flight
+    recorder is on, as an ``open`` span attributed to the shard."""
+    with timed("read.open", METRICS), trace("tfr:open"), \
+            telemetry.span("open", shard=path):
+        return open_fn(path, codec)
+
+
+def _timed_read(fh, want: int, path: str) -> bytes:
+    """The read-side sibling of ``_timed_open``: one slab read under the
+    ``read.io`` latency histogram and (recorder on) a ``read`` span. An
+    exception propagates untouched — the span self-marks ``failed=1`` and
+    no totals are recorded for the failed read."""
+    with telemetry.span("read", shard=path) as sp:
+        t0 = time.perf_counter()
+        data = fh.read(want)
+        dt = time.perf_counter() - t0
+        sp.set(bytes=len(data))
+    METRICS.add("read.io", nbytes=len(data), seconds=dt, latency=dt)
+    return data
 
 
 class CorruptQuotaError(Exception):
@@ -127,7 +152,7 @@ def salvage_spans_stream(
     if open_fn is None:
         open_fn = lambda p, c: wire.open_compressed(p, "rb", c)  # noqa: E731
     H, F = wire.HEADER_BYTES, wire.FOOTER_BYTES
-    with open_fn(path, codec) as fh:
+    with _timed_open(open_fn, path, codec) as fh:
         buf = b""
         file_off = 0  # decoded-stream offset of buf[0]
         bad_at: Optional[int] = None  # absolute start of current corrupt region
@@ -149,7 +174,7 @@ def salvage_spans_stream(
                     if declared <= max_record_bytes:
                         want = max(want, H + declared + F - len(buf))
                 try:
-                    data = fh.read(want)
+                    data = _timed_read(fh, want, path)
                 except _CODEC_CORRUPTION as e:
                     try:
                         on_event(
@@ -285,7 +310,7 @@ class ShardReader:
     def _ensure_open(self) -> None:
         if self._reader is None and not self._closed:
             codec = wire.codec_from_path(self.shard.path)
-            self._fh = self._open_stream(self.shard.path, codec)
+            self._fh = _timed_open(self._open_stream, self.shard.path, codec)
             self._reader = wire.RecordReader(self._fh, verify_crc=self._options.verify_crc)
 
     def close(self) -> None:
@@ -438,7 +463,7 @@ def scan_spans_stream(
     if open_fn is None:
         open_fn = lambda p, c: wire.open_compressed(p, "rb", c)  # noqa: E731
     remaining = max_records
-    with open_fn(path, codec) as fh:
+    with _timed_open(open_fn, path, codec) as fh:
         hint = make_hint(fh) if make_hint is not None else None
         carry = b""
         native = _native.available()
@@ -457,7 +482,7 @@ def scan_spans_stream(
                         f"({max_record_bytes}) in {path} — corrupt length field?"
                     )
                 want = max(want, 16 + declared - len(carry))
-            data = fh.read(want)
+            data = _timed_read(fh, want, path)
             if not data:
                 if carry:
                     raise wire.TFRecordCorruptionError(

@@ -46,7 +46,7 @@ from typing import Any, Callable, Deque, Dict, Iterable, List, Optional, Sequenc
 
 import numpy as np
 
-from tpu_tfrecord import fs as _fs, wire
+from tpu_tfrecord import fs as _fs, telemetry, wire
 from tpu_tfrecord.io import paths as p
 from tpu_tfrecord.metrics import METRICS, logger, timed
 from tpu_tfrecord.options import TFRecordOptions
@@ -137,6 +137,12 @@ class DatasetWriter:
         )
         self.write_workers = max(1, int(self.options.write_workers))
         self.num_shards = self.options.num_shards
+        # Flight recorder opt-in (same process-global contract as the
+        # dataset side: "on" enables, "off" leaves it alone).
+        if self.options.trace == "on":
+            telemetry.enable()
+        if self.options.telemetry_port is not None:
+            telemetry.ensure_exporter(self.options.telemetry_port)
         # Transient-fault policy for commit-side filesystem ops (shard open,
         # rename into place, _SUCCESS marker) — the remote-FS path is
         # demonstrably flaky (tests/test_fs_faults.py). An explicit policy
@@ -505,6 +511,14 @@ class _WriteJob:
         self._pending.append(path)
 
     def commit(self) -> List[str]:
+        with timed("write.commit", METRICS) as t, trace("tfr.write.commit"), \
+                telemetry.span("write.commit", job=self.job_id) as sp:
+            out = self._commit_inner()
+            t.records = len(out)
+            sp.set(shards=len(out))
+        return out
+
+    def _commit_inner(self) -> List[str]:
         # Pre-commit hygiene: staging left by a crashed previous job on this
         # host would pin the shared _temporary parent (the rmdir below would
         # fail forever) — sweep it before renaming into place.
@@ -641,6 +655,12 @@ class _SlabPipeline:
         self._inflight: Deque[Tuple[Future, _Stream, str]] = collections.deque()
         self._streams: Dict[Tuple[str, int], _Stream] = {}
         self._rr: Dict[str, int] = {}
+        # EMA of the in-flight deque's fill fraction, sampled per submit:
+        # ~1.0 means the planner keeps hitting the depth cap (the committer
+        # is the bottleneck — "consumer_bound" for the write pipeline);
+        # ~0.0 means slabs commit as fast as they are planned
+        # (encode/planner-bound). telemetry.boundness_verdict reads it.
+        self._occupancy = telemetry.OccupancyEma("write.occupancy")
 
     # -- planner side -------------------------------------------------------
 
@@ -669,6 +689,10 @@ class _SlabPipeline:
             )
             take = min(room, stop - pos, _PIPE_SLAB)
             path = stream.paths[-1]
+            self._occupancy.update(len(self._inflight) / self.depth)
+            METRICS.gauge("write.inflight_slabs", len(self._inflight))
+            if len(self._inflight) >= self.depth:
+                METRICS.count("write.backpressure_waits")
             while len(self._inflight) >= self.depth:
                 self._commit_one()
             fut = self._pool.submit(self._run_task, encode, pos, pos + take)
@@ -679,13 +703,15 @@ class _SlabPipeline:
     # -- worker side --------------------------------------------------------
 
     def _run_task(self, encode: Callable, start: int, stop: int):
-        with trace("tfr.write.encode"), timed("write.encode", METRICS) as t:
+        with trace("tfr.write.encode"), timed("write.encode", METRICS) as t, \
+                telemetry.span("write.encode", rows=stop - start):
             framed = encode(start, stop)
             t.records = stop - start
             t.bytes = _payload_len(framed)
         if not self._compress_in_worker:
             return framed, stop - start
-        with trace("tfr.write.compress"), timed("write.compress", METRICS) as t:
+        with trace("tfr.write.compress"), timed("write.compress", METRICS) as t, \
+                telemetry.span("write.compress", rows=stop - start):
             payload = wire.compress_chunk(self.codec, framed)
             t.records = stop - start
             t.bytes = len(payload)
@@ -697,7 +723,8 @@ class _SlabPipeline:
         fut, stream, path = self._inflight.popleft()
         payload, n_records = fut.result()  # re-raises worker errors
         self.job.heartbeat()  # lease stays fresh for long pipeline jobs
-        with trace("tfr.write.io"), timed("write.io", METRICS) as t:
+        with trace("tfr.write.io"), timed("write.io", METRICS) as t, \
+                telemetry.span("write.io", rows=n_records):
             if stream.sink_path != path:
                 # all slabs of a file precede slabs of the stream's next
                 # file (FIFO commit of an in-order plan), so a path switch

@@ -114,6 +114,21 @@ class TFRecordOptions:
       - cache_max_bytes: LRU budget for ``cache_dir`` (None = unbounded);
         oldest-unused entries are evicted after each populate commit
         (``cache.evictions``).
+      - trace: flight-recorder span tracing (tpu_tfrecord.telemetry).
+        ``"off"`` (default) records nothing and pays one attribute read
+        per would-be span; ``"on"`` records begin/end/thread/attrs for
+        every pipeline op (open, read, decode, cache.serve,
+        write.encode/compress/io, batch, stall/hedge/retry events) into a
+        bounded ring buffer exportable as Chrome trace-event JSON
+        (Perfetto-loadable). The recorder is process-global: any dataset
+        or writer constructed with ``trace="on"`` enables it.
+      - pulse_interval_s: emit one machine-parseable telemetry JSON line
+        per interval while an iterator is live (stage throughputs,
+        counters, gauges, histogram quantiles, and the producer/consumer
+        bound-ness verdict). None (default) = no pulse.
+      - telemetry_port: serve a Prometheus text endpoint (``/metrics``)
+        on 127.0.0.1:PORT via a stdlib HTTP daemon thread (0 = an
+        ephemeral port). None (default) = no endpoint.
     """
 
     record_type: RecordType = RecordType.EXAMPLE
@@ -136,6 +151,9 @@ class TFRecordOptions:
     cache: str = "off"
     cache_dir: Optional[str] = None
     cache_max_bytes: Optional[int] = None
+    trace: str = "off"
+    pulse_interval_s: Optional[float] = None
+    telemetry_port: Optional[int] = None
 
     _KNOWN_KEYS = (
         "recordType",
@@ -175,12 +193,18 @@ class TFRecordOptions:
         "cacheDir",
         "cache_max_bytes",
         "cacheMaxBytes",
+        "trace",
+        "pulse_interval_s",
+        "pulseIntervalS",
+        "telemetry_port",
+        "telemetryPort",
     )
 
     ON_CORRUPT_POLICIES = ("raise", "skip_record", "skip_shard")
     CORRUPT_FALLBACKS = ("raise", "skip_shard")
     ON_STALL_POLICIES = ("raise", "skip_shard")
     CACHE_MODES = ("off", "auto")
+    TRACE_MODES = ("off", "on")
 
     @staticmethod
     def from_map(options: Optional[Mapping[str, Any]] = None, **kwargs: Any) -> "TFRecordOptions":
@@ -287,6 +311,28 @@ class TFRecordOptions:
             cache_max_bytes = int(cache_max_bytes)
             if cache_max_bytes < 1:
                 raise ValueError("cache_max_bytes must be >= 1 (or None)")
+        trace = str(merged.pop("trace", "off") or "off").strip().lower()
+        if trace not in TFRecordOptions.TRACE_MODES:
+            raise ValueError(
+                f"trace must be one of {TFRecordOptions.TRACE_MODES}, "
+                f"got {trace!r}"
+            )
+        pulse_interval_s = merged.pop(
+            "pulse_interval_s", merged.pop("pulseIntervalS", None)
+        )
+        if pulse_interval_s is not None:
+            pulse_interval_s = float(pulse_interval_s)
+            if pulse_interval_s <= 0:
+                raise ValueError("pulse_interval_s must be > 0 (or None)")
+        telemetry_port = merged.pop(
+            "telemetry_port", merged.pop("telemetryPort", None)
+        )
+        if telemetry_port is not None:
+            telemetry_port = int(telemetry_port)
+            if not 0 <= telemetry_port <= 65535:
+                raise ValueError(
+                    "telemetry_port must be in [0, 65535] (0 = ephemeral)"
+                )
         if merged:
             import difflib
 
@@ -323,6 +369,9 @@ class TFRecordOptions:
             cache=cache,
             cache_dir=cache_dir,
             cache_max_bytes=cache_max_bytes,
+            trace=trace,
+            pulse_interval_s=pulse_interval_s,
+            telemetry_port=telemetry_port,
         )
 
     def with_schema(self, schema: StructType) -> "TFRecordOptions":

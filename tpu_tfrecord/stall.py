@@ -45,6 +45,7 @@ from concurrent.futures import wait as _wait_futures
 from time import monotonic as _monotonic
 from typing import BinaryIO, Callable, Optional
 
+from tpu_tfrecord import telemetry
 from tpu_tfrecord.metrics import METRICS, Metrics
 
 
@@ -241,6 +242,7 @@ class GuardedReadStream:
         byte range; first result wins, the loser is abandoned (bytes
         discarded, handle closed when its blocked call returns)."""
         self._metrics.count("read.hedges")
+        telemetry.instant("read.hedge", path=self._path)
         pos = self._fetched
         reopen = self._reopen
         backup_worker = _OpWorker(name="tfr-stall-hedge")
@@ -283,6 +285,7 @@ class GuardedReadStream:
                 return data
             backup_worker.close()
             self._metrics.count("read.hedge_wins")
+            telemetry.instant("read.hedge_win", path=self._path)
             old_worker = self._worker
             old_worker.abandon()
             _close_fh_when_done(primary_fut, self._fh)
@@ -301,6 +304,7 @@ class GuardedReadStream:
         self._wedged = True
         self._metrics.count("read.stalls")
         self._metrics.count("read.deadline_misses")
+        telemetry.instant("read.stall", path=self._path, kind="read_deadline")
         self._worker.abandon()
         _close_fh_when_done(fut, self._fh)
         raise DeadlineError(
@@ -417,6 +421,7 @@ class StallGuard:
             _close_result_when_done(fut)
             self.metrics.count("read.stalls")
             self.metrics.count("read.deadline_misses")
+            telemetry.instant("read.stall", path=path, kind="open_deadline")
             raise DeadlineError(
                 f"open exceeded deadline "
                 f"({self.open_deadline * 1000:.0f} ms) on {path}"

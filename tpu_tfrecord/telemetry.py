@@ -1,0 +1,730 @@
+"""Pipeline flight recorder: span tracing, latency histograms, telemetry
+pulse, and the producer/consumer bound-ness verdict.
+
+The reference has no observability of its own (SURVEY.md §5: tracing ABSENT
+— it rides on Spark's UI), and `Metrics` only aggregates per-stage TOTALS:
+after an epoch you know decode took N seconds, but not the latency
+distribution, which shard was the straggler, or whether the pipeline was
+producer- or consumer-bound at any moment. tf.data's auto-tuning and the
+tf.data service rest on exactly this kind of per-op timing instrumentation
+(PAPERS.md) — a slow epoch should be explainable without attaching a
+profiler. Four pieces:
+
+- **Span tracing** (``SpanRecorder``): a thread-safe, bounded ring buffer
+  of (name, begin, duration, thread, attrs) records, written through the
+  ``span(name, **attrs)`` context manager and ``instant(name, **attrs)``
+  point events. Opt-in via ``TFRecordOptions(trace="on")`` — when off, the
+  module-level ``span()``/``instant()`` return a shared no-op without
+  taking any lock (one attribute read on the hot path). Exportable as
+  Chrome trace-event JSON (``to_chrome_trace``/``save_chrome_trace``) —
+  loadable in Perfetto / chrome://tracing. Spans are mirrored onto the
+  jax-profiler timeline through the existing ``tracing.trace`` annotations
+  every instrumented site already holds, so xprof captures show the same
+  regions.
+
+- **Latency histograms** (``Histogram``): log-bucketed (~19% geometric
+  buckets → quantile relative error ≤ ~10%), folded into ``Metrics`` via
+  ``Metrics.observe``/the ``timed`` context manager, so every timed stage
+  (shard open, slab read, chunk decode, cache serve, write/commit) grows a
+  p50/p90/p99 next to its totals and stragglers stop hiding inside means.
+
+- **Telemetry pulse** (``Pulse``): a background reporter emitting one
+  machine-parseable JSON line per interval — per-interval stage
+  throughputs, cumulative counters, histogram quantiles, gauges (prefetch
+  queue depth, in-flight decode workers, backpressure occupancy), and the
+  bound-ness verdict. Opt-in via ``TFRecordOptions(pulse_interval_s=...)``;
+  an optional stdlib-HTTP Prometheus text endpoint
+  (``TFRecordOptions(telemetry_port=...)`` / ``ensure_exporter``) serves
+  the same registry for scraping.
+
+- **Bound-ness verdict** (``boundness_verdict``): computed from the
+  prefetch queue's average fill fraction, sampled by the consumer. A queue
+  that is nearly always FULL means decode keeps ahead of the consumer —
+  the pipeline is consumer-bound (the device/training step is the
+  bottleneck; the BASELINE.md goal state). Nearly always EMPTY means the
+  consumer drains batches faster than decode produces them —
+  producer-bound (speed up the input pipeline).
+
+The offline complement is ``tools/tfrecord_doctor.py report DATA_DIR``:
+run N batches with tracing on and print the stage breakdown, slowest
+shards, straggler ratio, and the verdict.
+
+This module deliberately imports nothing from the rest of the package at
+module level (stdlib only; the default-registry lookups import
+``metrics`` lazily), so every layer — metrics, io, cache, stall — can
+import it without cycles.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "Histogram",
+    "SpanRecorder",
+    "Pulse",
+    "RECORDER",
+    "span",
+    "instant",
+    "enable",
+    "disable",
+    "boundness_verdict",
+    "verdict_from_metrics",
+    "OccupancyEma",
+    "quantiles_ms",
+    "prometheus_text",
+    "ensure_exporter",
+    "exporter_address",
+    "shutdown_exporter",
+]
+
+
+# ---------------------------------------------------------------------------
+# Latency histograms
+# ---------------------------------------------------------------------------
+
+
+class Histogram:
+    """Log-bucketed latency histogram with quantile estimation.
+
+    Buckets grow geometrically by ``2**0.25`` (~19% per bucket) from a
+    100 ns floor, spanning 100 ns .. ~1.9 h in 144 fixed buckets — so one
+    histogram is a flat int list, O(1) to observe and cheap to snapshot.
+    Quantiles interpolate at the log-midpoint of the selected bucket and
+    clamp to the observed [min, max], bounding the relative error at
+    ``sqrt(2**0.25) - 1`` ≈ 9.1% (pinned against a reference sort in
+    tests/test_telemetry.py).
+
+    NOT internally locked: the owner (``Metrics``) serializes access under
+    its own lock so one observation costs one lock acquisition total.
+    """
+
+    _MIN = 1e-7  # 100 ns floor: anything faster is bucket 0
+    _LOG2_GROWTH = 0.25  # buckets grow by 2**0.25 per step
+    _NBUCKETS = 144  # 144 * 0.25 = 36 octaves above _MIN (~1.9 h)
+
+    __slots__ = ("counts", "count", "total", "min", "max")
+
+    def __init__(self) -> None:
+        self.counts = [0] * self._NBUCKETS
+        self.count = 0
+        self.total = 0.0
+        self.min = math.inf
+        self.max = 0.0
+
+    def observe(self, value: float) -> None:
+        if value <= self._MIN:
+            idx = 0
+        else:
+            idx = min(
+                self._NBUCKETS - 1,
+                1 + int(math.log2(value / self._MIN) / self._LOG2_GROWTH),
+            )
+        self.counts[idx] += 1
+        self.count += 1
+        self.total += value
+        if value < self.min:
+            self.min = value
+        if value > self.max:
+            self.max = value
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated value at quantile ``q`` in [0, 1] (None when empty)."""
+        if self.count == 0:
+            return None
+        rank = q * self.count
+        cum = 0
+        for idx, c in enumerate(self.counts):
+            cum += c
+            if cum >= rank and c:
+                if idx == 0:
+                    est = self._MIN
+                else:
+                    # log-midpoint of the bucket [g**(idx-1), g**idx) * _MIN
+                    est = self._MIN * 2 ** ((idx - 0.5) * self._LOG2_GROWTH)
+                return min(max(est, self.min), self.max)
+        return self.max
+
+    def quantiles(self) -> Dict[str, float]:
+        """The standard p50/p90/p99 snapshot (seconds), plus count/mean."""
+        if self.count == 0:
+            return {}
+        return {
+            "p50_s": self.quantile(0.50),
+            "p90_s": self.quantile(0.90),
+            "p99_s": self.quantile(0.99),
+            "count": self.count,
+            "mean_s": self.total / self.count,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Span tracing
+# ---------------------------------------------------------------------------
+
+
+class _NoopSpan:
+    """The shared disabled-path context manager: no state, no lock."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+    def set(self, **attrs) -> None:
+        return None
+
+
+_NOOP = _NoopSpan()
+
+
+class _SpanCtx:
+    """One live span: records (name, begin, duration, tid, attrs) into its
+    recorder on exit. An exception propagating through the span marks it
+    ``failed=1`` — error latency stays attributed to its stage."""
+
+    __slots__ = ("_rec", "name", "attrs", "_t0")
+
+    def __init__(self, rec: "SpanRecorder", name: str, attrs: Optional[dict]):
+        self._rec = rec
+        self.name = name
+        self.attrs = attrs
+
+    def set(self, **attrs) -> None:
+        """Attach attrs discovered mid-span (row counts, byte counts)."""
+        if self.attrs is None:
+            self.attrs = attrs
+        else:
+            self.attrs.update(attrs)
+
+    def __enter__(self) -> "_SpanCtx":
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        dur = time.perf_counter_ns() - self._t0
+        attrs = self.attrs
+        if exc_type is not None:
+            attrs = dict(attrs or (), failed=1)
+        self._rec._record(self.name, self._t0, dur, attrs, "X")
+        return None
+
+
+class SpanRecorder:
+    """Thread-safe bounded ring buffer of span/instant records.
+
+    ``capacity`` bounds memory for arbitrarily long epochs: the buffer
+    keeps the most recent ``capacity`` records and counts what it dropped
+    (``dropped``) — a flight recorder, not an archive. ``enabled`` is a
+    plain attribute read on the hot path; when False, the module-level
+    ``span()``/``instant()`` return the shared no-op without touching this
+    object's lock (pinned by tests/test_telemetry.py).
+    """
+
+    def __init__(self, capacity: int = 65536, enabled: bool = False):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = capacity
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        # ring storage: fixed-size list + running sequence number
+        self._ring: List[Optional[tuple]] = [None] * capacity
+        self._seq = 0
+        self.dropped = 0
+
+    # -- recording -----------------------------------------------------------
+
+    def span(self, name: str, **attrs) -> "_SpanCtx | _NoopSpan":
+        if not self.enabled:
+            return _NOOP
+        return _SpanCtx(self, name, attrs or None)
+
+    def instant(self, name: str, **attrs) -> None:
+        if not self.enabled:
+            return
+        self._record(name, time.perf_counter_ns(), 0, attrs or None, "i")
+
+    def _record(
+        self, name: str, t0_ns: int, dur_ns: int, attrs: Optional[dict], ph: str
+    ) -> None:
+        tid = threading.get_ident()
+        with self._lock:
+            seq = self._seq
+            self._seq = seq + 1
+            if seq >= self.capacity:
+                self.dropped += 1
+            self._ring[seq % self.capacity] = (name, t0_ns, dur_ns, tid, attrs, ph)
+
+    # -- reading -------------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return min(self._seq, self.capacity)
+
+    def spans(self) -> List[tuple]:
+        """Snapshot of retained records, oldest first:
+        (name, t0_ns, dur_ns, tid, attrs, ph)."""
+        with self._lock:
+            seq = self._seq
+            if seq <= self.capacity:
+                return [r for r in self._ring[:seq]]
+            start = seq % self.capacity
+            return [
+                r
+                for r in (self._ring[start:] + self._ring[:start])
+                if r is not None
+            ]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring = [None] * self.capacity
+            self._seq = 0
+            self.dropped = 0
+
+    # -- export --------------------------------------------------------------
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        """The retained records as a Chrome trace-event JSON object
+        (https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU
+        — the format Perfetto and chrome://tracing load). Durations are
+        complete ("X") events; point events are instants ("i")."""
+        pid = os.getpid()
+        events = []
+        for name, t0_ns, dur_ns, tid, attrs, ph in self.spans():
+            ev: Dict[str, Any] = {
+                "name": name,
+                "cat": "tfrecord",
+                "ph": ph,
+                "ts": t0_ns / 1000.0,  # microseconds
+                "pid": pid,
+                "tid": tid,
+            }
+            if ph == "X":
+                ev["dur"] = dur_ns / 1000.0
+            else:
+                ev["s"] = "t"  # thread-scoped instant
+            if attrs:
+                ev["args"] = attrs
+            events.append(ev)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def save_chrome_trace(self, path: str) -> None:
+        with open(path, "w") as fh:
+            json.dump(self.to_chrome_trace(), fh)
+
+
+#: Process-global flight recorder — spans come from dataset iterators,
+#: prefetch workers, writer pipeline threads, and the stall guard, so the
+#: ring is shared (one timeline). ``TFRecordOptions(trace="on")`` enables it
+#: at dataset/writer construction; it stays on until ``disable()``.
+RECORDER = SpanRecorder()
+
+
+def span(name: str, **attrs):
+    """Record a duration span on the global recorder; a shared no-op (no
+    lock, no allocation beyond the caller's kwargs) when tracing is off."""
+    rec = RECORDER
+    if not rec.enabled:
+        return _NOOP
+    return _SpanCtx(rec, name, attrs or None)
+
+
+def instant(name: str, **attrs) -> None:
+    """Record a point event (stall, hedge, retry, watchdog restart)."""
+    rec = RECORDER
+    if rec.enabled:
+        rec._record(name, time.perf_counter_ns(), 0, attrs or None, "i")
+
+
+def record_span(name: str, t0_ns: int, dur_ns: int, **attrs) -> None:
+    """Record an already-measured duration span — for callers that time a
+    region manually and only know its extent after the fact (the
+    consumer-side ``batch`` wait, which must not mark a terminal
+    StopIteration as a failed span)."""
+    rec = RECORDER
+    if rec.enabled:
+        rec._record(name, t0_ns, dur_ns, attrs or None, "X")
+
+
+def enable() -> SpanRecorder:
+    RECORDER.enabled = True
+    return RECORDER
+
+
+def disable() -> None:
+    RECORDER.enabled = False
+
+
+# ---------------------------------------------------------------------------
+# Bound-ness verdict
+# ---------------------------------------------------------------------------
+
+#: Gauge the consumer-side iterator maintains: EMA of the prefetch queue's
+#: fill fraction sampled at each batch get (see io/dataset.py).
+OCCUPANCY_GAUGE = "prefetch.occupancy"
+
+
+def boundness_verdict(occupancy: Optional[float]) -> str:
+    """Producer/consumer verdict from a queue fill fraction in [0, 1].
+
+    ≥ 0.66: the queue is mostly full — the producer (decode) keeps ahead,
+    so the CONSUMER is the bottleneck (``consumer_bound``; for a training
+    loop this is the goal state: the device never waits on input).
+    ≤ 0.33: mostly empty — the consumer drains faster than decode refills
+    (``producer_bound``: speed up the input pipeline — more workers,
+    cache, faster store). Between: ``balanced``. None: ``unknown`` (no
+    samples yet)."""
+    if occupancy is None:
+        return "unknown"
+    if occupancy >= 0.66:
+        return "consumer_bound"
+    if occupancy <= 0.33:
+        return "producer_bound"
+    return "balanced"
+
+
+def verdict_from_metrics(metrics=None, gauge: str = OCCUPANCY_GAUGE) -> str:
+    """The verdict for a metrics registry's occupancy gauge (the process
+    default registry when ``metrics`` is None)."""
+    if metrics is None:
+        from tpu_tfrecord.metrics import METRICS as metrics  # noqa: N813
+    return boundness_verdict(metrics.gauge_value(gauge))
+
+
+class OccupancyEma:
+    """Shared smoothing for the bound-ness occupancy gauges: one EMA
+    (alpha 0.2 — the verdict reflects the recent regime, not the epoch's
+    warmup) feeding one named gauge. Used by the consumer iterator
+    (``prefetch.occupancy``) and the write slab pipeline
+    (``write.occupancy``), so both verdicts read identically-smoothed
+    signals."""
+
+    __slots__ = ("gauge", "alpha", "value")
+
+    def __init__(self, gauge: str, alpha: float = 0.2):
+        self.gauge = gauge
+        self.alpha = alpha
+        self.value: Optional[float] = None
+
+    def update(self, fill: float, metrics=None) -> float:
+        v = self.value
+        self.value = (
+            fill if v is None else (1.0 - self.alpha) * v + self.alpha * fill
+        )
+        if metrics is None:
+            from tpu_tfrecord.metrics import METRICS as metrics  # noqa: N813
+        metrics.gauge(self.gauge, self.value)
+        return self.value
+
+
+def quantiles_ms(source: Dict[str, Dict[str, float]]) -> Dict[str, Dict[str, float]]:
+    """Convert a ``Metrics.quantiles()`` mapping — or any mapping whose
+    entries carry ``p50_s``/``p90_s``/``p99_s`` (``snapshot()`` stage
+    entries qualify) — into the shared milliseconds shape the pulse,
+    bench, and doctor lines all emit, so their field sets cannot drift
+    apart. Entries without quantiles are skipped."""
+    out: Dict[str, Dict[str, float]] = {}
+    for name, q in sorted(source.items()):
+        if not q or "p50_s" not in q:
+            continue
+        entry = {
+            "p50_ms": round(q["p50_s"] * 1e3, 3),
+            "p90_ms": round(q["p90_s"] * 1e3, 3),
+            "p99_ms": round(q["p99_s"] * 1e3, 3),
+        }
+        if "count" in q:
+            entry["count"] = q["count"]
+        elif "hist_count" in q:
+            entry["count"] = int(q["hist_count"])
+        out[name] = entry
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Telemetry pulse
+# ---------------------------------------------------------------------------
+
+
+class Pulse:
+    """Periodic one-line-JSON telemetry reporter.
+
+    Every ``interval_s`` the pulse thread emits one machine-parseable dict
+    through ``emit`` (default: a ``tfrecord.pulse {json}`` INFO line on the
+    package logger — the same fleet-log convention as
+    ``log_salvage_event``). Stage throughputs are PER-INTERVAL deltas
+    (records/bytes produced this interval over the interval wall time), so
+    a stall shows up as the pulse going to zero, not as a slowly decaying
+    lifetime average; counters, gauges, and histogram quantiles are
+    cumulative snapshots. ``tick()`` is public so tests and the doctor can
+    force a pulse without waiting out the interval."""
+
+    def __init__(
+        self,
+        interval_s: float,
+        metrics=None,
+        emit: Optional[Callable[[Dict[str, Any]], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if interval_s <= 0:
+            raise ValueError("interval_s must be > 0")
+        if metrics is None:
+            from tpu_tfrecord.metrics import METRICS as metrics  # noqa: N813
+        self.interval_s = interval_s
+        self.metrics = metrics
+        self.emit = emit if emit is not None else _log_pulse
+        self._clock = clock
+        self._prev_totals: Dict[str, Tuple[int, int, int, float]] = {}
+        self._prev_t = clock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Pulse":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="tfr-pulse"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, final: bool = True) -> None:
+        """Stop the thread; ``final`` emits one last pulse covering the
+        tail interval so short epochs still leave a line behind.
+        Idempotent: a second stop (iterator close + GC finalizer) does
+        nothing."""
+        already = self._stop.is_set()
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._thread = None
+        if final and not already:
+            try:
+                self.tick()
+            except Exception:
+                pass
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:
+                # telemetry must never take the pipeline down
+                pass
+
+    def tick(self) -> Dict[str, Any]:
+        """Compute and emit one pulse line; returns the emitted dict."""
+        now = self._clock()
+        dt = max(now - self._prev_t, 1e-9)
+        self._prev_t = now
+        totals = self.metrics.raw_totals()
+        stages: Dict[str, Dict[str, float]] = {}
+        counters: Dict[str, int] = {}
+        for name, (records, nbytes, batches, seconds) in sorted(totals.items()):
+            prev = self._prev_totals.get(name, (0, 0, 0, 0.0))
+            d_rec = records - prev[0]
+            d_bytes = nbytes - prev[1]
+            if seconds == 0.0 and nbytes == 0:
+                # a pure count()-style event counter (read.retries,
+                # cache.hits, *.errors): cumulative total + interval delta
+                counters[name] = records
+                if d_rec:
+                    counters[name + ".delta"] = d_rec
+                continue
+            stages[name] = {
+                "records_per_sec": round(d_rec / dt, 1),
+                "bytes_per_sec": round(d_bytes / dt, 1),
+                "records": records,
+            }
+        self._prev_totals = totals
+        gauges = self.metrics.gauges()
+        quantiles = quantiles_ms(self.metrics.quantiles())
+        payload = {
+            "event": "pulse",
+            "ts": round(time.time(), 3),
+            "interval_s": round(dt, 3),
+            "stages": stages,
+            "counters": counters,
+            "gauges": {k: round(v, 4) for k, v in sorted(gauges.items())},
+            "quantiles": quantiles,
+            "verdict": boundness_verdict(gauges.get(OCCUPANCY_GAUGE)),
+        }
+        self.emit(payload)
+        return payload
+
+
+def _log_pulse(payload: Dict[str, Any]) -> None:
+    from tpu_tfrecord.metrics import logger
+
+    logger.info("tfrecord.pulse %s", json.dumps(payload, sort_keys=True))
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text endpoint (stdlib HTTP only)
+# ---------------------------------------------------------------------------
+
+
+def prometheus_text(metrics=None) -> str:
+    """The registry in Prometheus text exposition format: stage totals as
+    counters, gauges as gauges, histogram quantiles as a summary-style
+    family. Stage/gauge names ride in label values (where dots are legal),
+    so the metric-family names stay fixed and dashboards survive new
+    stages."""
+    if metrics is None:
+        from tpu_tfrecord.metrics import METRICS as metrics  # noqa: N813
+    totals = sorted(metrics.raw_totals().items())
+    lines: List[str] = []
+
+    def family(fam: str, ftype: str, samples: List[str]) -> None:
+        # the exposition format requires every sample of one metric family
+        # to form a single contiguous block under its # TYPE line —
+        # interleaving families per stage makes strict parsers (promtool,
+        # OpenMetrics scrapes) reject the page as duplicate families
+        if samples:
+            lines.append(f"# TYPE {fam} {ftype}")
+            lines.extend(samples)
+
+    family(
+        "tfrecord_stage_records_total",
+        "counter",
+        [
+            f'tfrecord_stage_records_total{{stage="{n}"}} {r}'
+            for n, (r, _b, _bt, _s) in totals
+        ],
+    )
+    family(
+        "tfrecord_stage_bytes_total",
+        "counter",
+        [
+            f'tfrecord_stage_bytes_total{{stage="{n}"}} {b}'
+            for n, (_r, b, _bt, _s) in totals
+            if b
+        ],
+    )
+    family(
+        "tfrecord_stage_seconds_total",
+        "counter",
+        [
+            f'tfrecord_stage_seconds_total{{stage="{n}"}} {s:.6f}'
+            for n, (_r, _b, _bt, s) in totals
+            if s
+        ],
+    )
+    family(
+        "tfrecord_gauge",
+        "gauge",
+        [
+            f'tfrecord_gauge{{name="{name}"}} {value:.6g}'
+            for name, value in sorted(metrics.gauges().items())
+        ],
+    )
+    latency: List[str] = []
+    for name, q in sorted(metrics.quantiles().items()):
+        if not q:
+            continue
+        for key, quant in (("p50_s", "0.5"), ("p90_s", "0.9"), ("p99_s", "0.99")):
+            latency.append(
+                f'tfrecord_latency_seconds{{stage="{name}",'
+                f'quantile="{quant}"}} {q[key]:.9f}'
+            )
+        latency.append(
+            f'tfrecord_latency_seconds_count{{stage="{name}"}} {q["count"]}'
+        )
+    family("tfrecord_latency_seconds", "summary", latency)
+    return "\n".join(lines) + "\n"
+
+
+_EXPORTERS: Dict[int, Any] = {}
+_EXPORTERS_LOCK = threading.Lock()
+
+
+def ensure_exporter(port: int, metrics=None):
+    """Start (or return the already-running) Prometheus text endpoint on
+    ``port`` — process-wide, idempotent per port, daemon-threaded. ``port``
+    0 binds an ephemeral port; the bound address is logged at startup and
+    queryable via ``exporter_address(port)`` (keyed by the REQUESTED port,
+    as is ``shutdown_exporter`` — pass 0 back, not the ephemeral number).
+    Serves ``/metrics`` (and ``/`` as an alias); anything else 404s.
+    Stdlib ``http.server`` only — no new dependencies. A port that cannot
+    be bound (already taken by another process) logs a warning and returns
+    None — telemetry must never take the pipeline down."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    if metrics is None:
+        from tpu_tfrecord.metrics import METRICS as metrics  # noqa: N813
+
+    from tpu_tfrecord.metrics import logger
+
+    with _EXPORTERS_LOCK:
+        server = _EXPORTERS.get(port)
+        if server is not None:
+            return server
+
+        reg = metrics
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = prometheus_text(reg).encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:  # quiet: telemetry, not access logs
+                return
+
+        try:
+            server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        except OSError as e:
+            # a taken port (two processes sharing one config) must not
+            # take the pipeline down — telemetry is never load-bearing
+            logger.warning(
+                "tfrecord.telemetry prometheus endpoint on port %d "
+                "unavailable (%s); continuing without it", port, e,
+            )
+            return None
+        server.daemon_threads = True
+        threading.Thread(
+            target=server.serve_forever, daemon=True, name="tfr-prometheus"
+        ).start()
+        _EXPORTERS[port] = server
+        host, bound = server.server_address[:2]
+        logger.info(
+            "tfrecord.telemetry prometheus endpoint on http://%s:%d/metrics",
+            host, bound,
+        )
+        return server
+
+
+def exporter_address(port: int) -> Optional[Tuple[str, int]]:
+    """(host, bound_port) of the exporter started for REQUESTED ``port``
+    (the public way to learn which ephemeral port ``telemetry_port=0``
+    actually bound), or None when none is running."""
+    with _EXPORTERS_LOCK:
+        server = _EXPORTERS.get(port)
+    return server.server_address[:2] if server is not None else None
+
+
+def shutdown_exporter(port: int) -> None:
+    """Stop the exporter started for REQUESTED ``port`` (tests; production
+    leaves it up). For an ephemeral exporter pass 0 — the key is the port
+    you asked for, not the one the OS picked."""
+    with _EXPORTERS_LOCK:
+        server = _EXPORTERS.pop(port, None)
+    if server is not None:
+        server.shutdown()
+        server.server_close()

@@ -6,6 +6,9 @@ explain itself:
 
 - ``trace(name)``: annotates a host-side region so it shows up on the xprof
   timeline next to device ops (no-op when jax/profiler is unavailable).
+  The flight recorder (tpu_tfrecord.telemetry) rides next to these: every
+  span-instrumented pipeline site also holds a ``trace`` annotation, so an
+  xprof capture shows the same regions the Chrome-trace export does.
 - ``start_trace/stop_trace``: wrap jax.profiler for a whole capture.
 - ``DutyCycle``: estimates the BASELINE.md north-star secondary metric — the
   fraction of wall time the device spends computing vs waiting on input —
@@ -36,15 +39,34 @@ def _profiler():
     return _PROF
 
 
-@contextlib.contextmanager
+class _NullTrace:
+    """Shared no-op context manager for the profiler-less path."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullTrace":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+_NULL_TRACE = _NullTrace()
+
+
 def trace(name: str):
-    """Annotate a host-side region on the profiler timeline."""
+    """Annotate a host-side region on the profiler timeline.
+
+    Returns the profiler's TraceAnnotation directly (it IS a context
+    manager) instead of wrapping it in a generator — ``trace`` sits on
+    per-chunk hot paths (decode, cache serve, write stages), where the old
+    ``@contextlib.contextmanager`` layer allocated a generator per call
+    even with no profiler present. With jax unavailable a shared no-op is
+    returned: zero allocation per call."""
     prof = _profiler()
     if prof is None:
-        yield
-        return
-    with prof.TraceAnnotation(name):
-        yield
+        return _NULL_TRACE
+    return prof.TraceAnnotation(name)
 
 
 def start_trace(logdir: str) -> None:
