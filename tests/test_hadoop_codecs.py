@@ -89,6 +89,23 @@ class TestRawLz4:
         with pytest.raises(wire.TFRecordCorruptionError):
             lz4_decompress(blob)
 
+    def test_native_size_guard_falls_back(self, monkeypatch):
+        """Inputs past the native encoder's int32 match-table contract
+        (>= 2 GiB) must skip the native path and still produce valid lz4
+        (ADVICE: lz4 >= 2GiB guard). The threshold is shrunk so the guard
+        is exercised without allocating 2 GiB; the fallback's literal-only
+        output is recognizable by its 0xF0 full-literal token."""
+        from tpu_tfrecord import hadoop_codecs
+
+        payload = b"abcdefgh" * 1024  # compressible: native WOULD emit matches
+        native_blob = lz4_compress(payload)
+        monkeypatch.setattr(hadoop_codecs, "LZ4_NATIVE_MAX_BYTES", 16)
+        guarded_blob = lz4_compress(payload)
+        assert lz4_decompress(guarded_blob) == payload
+        assert guarded_blob[0] == 0xF0  # literal-only fallback, not native
+        if native_blob[0] != 0xF0:  # native available: guard changed dispatch
+            assert guarded_blob != native_blob
+
 
 @pytest.mark.parametrize("codec,ext", [
     ("snappy", ".snappy"), ("lz4", ".lz4"), ("bzip2", ".bz2"),

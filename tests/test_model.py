@@ -209,6 +209,47 @@ class TestSparseTrainStep:
             got_p["embeddings"], want_p["embeddings"], rtol=2e-5, atol=1e-7
         )
 
+    def test_pair_sort_path_matches_flat_keys(self, monkeypatch):
+        """ADVICE close-out: for F*V > 2^31, flat int32 dedup keys would
+        silently wrap (int64 is unavailable with x64 disabled), so the
+        step switches to a lexicographic (f, v) pair sort. Both paths are
+        stable sorts over the same total order, so the permutation — and
+        therefore every update — is identical; pinned at test scale by
+        shrinking the switch-over threshold."""
+        from tpu_tfrecord.models import sparse_opt_init, sparse_train_step
+        from tpu_tfrecord.models import dlrm as dlrm_mod
+
+        # the sort seam itself, on skewed duplicate-heavy indices
+        rng = np.random.default_rng(31)
+        f_flat = jax.numpy.asarray(
+            np.repeat(np.arange(3), 32).astype(np.int32)
+        )
+        v_flat = jax.numpy.asarray(rng.integers(0, 6, 96).astype(np.int32))
+        flat = dlrm_mod._dedup_sort(f_flat, v_flat, 6, force_pairs=False)
+        pairs = dlrm_mod._dedup_sort(f_flat, v_flat, 6, force_pairs=True)
+        for got, want in zip(pairs, flat):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+        # and the full step end-to-end with the pair path forced
+        cfg = self.CFG
+        params = init_params(jax.random.key(12), cfg)
+        host = make_synthetic_batch(cfg, 32, seed=33)
+        host["cat"] = rng.integers(0, 6, size=host["cat"].shape)
+        batch = {k: jax.numpy.asarray(v) for k, v in host.items()}
+        tx = optax.sgd(1e-2)
+        opt0 = sparse_opt_init(params, cfg, tx)
+        step = functools.partial(sparse_train_step, cfg=cfg, tx=tx)
+        want_p, want_s, want_l = jax.jit(step)(params, opt0, batch)
+        monkeypatch.setattr(dlrm_mod, "_FLAT_KEY_MAX", 1)
+        got_p, got_s, got_l = jax.jit(step)(params, opt0, batch)
+        assert float(got_l) == pytest.approx(float(want_l), rel=1e-6)
+        np.testing.assert_array_equal(
+            np.asarray(got_s.accum), np.asarray(want_s.accum)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got_p["embeddings"]), np.asarray(want_p["embeddings"])
+        )
+
     def test_sharded_sparse_step_matches_single_device(self):
         from tpu_tfrecord.models import sparse_opt_init, sparse_train_step
         from tpu_tfrecord.models.dlrm import batch_shardings

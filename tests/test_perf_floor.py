@@ -1,21 +1,40 @@
-"""Red/green decode-throughput floor (VERDICT r3 item 3).
+"""Red/green decode-throughput floor (VERDICT r3 item 3), calibrated to
+the box it runs on (VERDICT r5 item 5).
 
 A decode regression must be caught by CI as a failing test, not discovered
 rounds later as a mysteriously degraded bench headline. This pins the
 device-free pipeline — native frame scan + CRC + Example decode +
 categorical hashing + column-group packing at the bench's Criteo shape —
-above a conservative floor.
+above a floor DERIVED from an in-process microbench INTERLEAVED with the
+measurement windows.
 
-Floor calibration: the bench box measures ~1.4-1.7M ex/s on this path
-(BENCH_r03.json host_side_value). The default floor of 500k ex/s holds
-across slower CI machines while still tripping on the regression classes
-that matter: native decoder silently disabled (~10x), turbo entry-shape
-cache broken (falls back to field-wise parse, ~2-3x), per-batch copies
-reintroduced. TFR_PERF_FLOOR_EX_S overrides for stricter local runs.
+Why calibrate: a fixed floor must sit low enough for the slowest CI box,
+which on the reference box left a 2.6-3x cushion — a 30% decode regression
+sailed under it. The microbench (memcpy + zlib.crc32 over a 4MB buffer)
+tracks the box's single-thread memory/CPU speed — the same resources the
+decode path is bound by — but shares NO code with it, so a decode-path
+regression moves the measurement and not the floor.
+
+Why interleave: this box's throughput swings ±40% minute to minute under
+other tenants' load, so a floor calibrated once at import would compare a
+loaded measurement against an idle calibration (or vice versa). Each test
+alternates microbench sample / decode window and takes the best of each —
+both one-sided noise estimators over the SAME interference regime — and
+the floor is ``REGRESSION_TRIP`` x the reference decode-per-microbench
+ratio x this run's best microbench rate. The best/best ratio was measured
+stable within ~10% across load levels on the reference box while single
+windows swung 3x (the constants below are its observed center).
+
+TFR_PERF_FLOOR_EX_S / TFR_SEQ_PERF_FLOOR_EX_S still override outright;
+TFR_PERF_FLOOR_SELFTEST_PCT=30 degrades the measured value by 30% before
+the assert — the red-path check that the calibrated floor actually trips
+(wired into tools/verify.sh runs of this file is overkill; run it by hand
+when touching the calibration).
 """
 
 import os
 import time
+import zlib
 
 import numpy as np
 import pytest
@@ -32,13 +51,44 @@ from tpu_tfrecord.schema import (
 )
 from tpu_tfrecord.serde import TFRecordSerializer, encode_row
 
-FLOOR = float(os.environ.get("TFR_PERF_FLOOR_EX_S", 500_000))
-# SequenceExample floor: the bench box measures ~250k ex/s on the fused
-# native pad+bf16 path ([B, 64, 16] frames); 80k holds the same ~3x slack
-# as the Criteo floor while tripping on the regression classes that matter
-# here: fused pad kernel lost (falls back through numpy, and a further fall
-# to any per-row path lands at ~16k).
-SEQ_FLOOR = float(os.environ.get("TFR_SEQ_PERF_FLOOR_EX_S", 80_000))
+# Reference ratios (examples decoded per MB/s of microbench rate),
+# measured interleaved on the bench box across idle and loaded phases:
+# Criteo best/best 905-990 (center 960), seq best/best 149-168 (165 holds
+# the 30% self-test honest while leaving ~20% false-fail headroom).
+_REF_CRITEO_RATIO = 960.0
+_REF_SEQ_RATIO = 165.0
+# a 30% regression must trip: floor = 75% of the box-expected rate
+# (0.75 rather than 0.70 buys the self-test margin against ratio noise)
+REGRESSION_TRIP = 0.75
+
+_MEMCRC_BUF = np.random.default_rng(0).integers(0, 256, 4 << 20, np.uint8).tobytes()
+
+
+def _memcrc_mbps() -> float:
+    """One microbench sample: memcpy + zlib.crc32 over a 4MB buffer,
+    best-of-2 inner reps, in MB/s."""
+    best = 0.0
+    for _ in range(2):
+        t0 = time.perf_counter()
+        reps = 4
+        for _ in range(reps):
+            zlib.crc32(_MEMCRC_BUF)
+            bytes(memoryview(_MEMCRC_BUF))  # the memcpy half
+        dt = time.perf_counter() - t0
+        best = max(best, reps * len(_MEMCRC_BUF) / dt)
+    return best / 1e6
+
+
+def _calibrated_floor(env_var: str, ratio: float, micro_mbps: float) -> float:
+    override = os.environ.get(env_var)
+    if override is not None:
+        return float(override)
+    return REGRESSION_TRIP * ratio * micro_mbps
+
+
+# red-path self-test: degrade the measurement by this percent before the
+# assert (TFR_PERF_FLOOR_SELFTEST_PCT=30 must FAIL both floors)
+_SELFTEST_SCALE = 1.0 - float(os.environ.get("TFR_PERF_FLOOR_SELFTEST_PCT", 0)) / 100.0
 N_RECORDS = 16384
 BATCH = 4096
 
@@ -89,13 +139,17 @@ def test_criteo_decode_hash_pack_floor(tmp_path):
         pack=pack,
     )
     best = 0.0
+    micro = 0.0
     with ds.batches() as it:
         for _ in range(3):  # warm decode thread + entry-shape caches
             host_batch_from_columnar(next(it), ds.schema,
                                      hash_buckets=hash_buckets, pack=pack)
-        # best-of-3 half-second windows: one-sided noise on a shared box
-        # (other tenants only slow us down), so the max is the estimator
+        # best-of-3 half-second windows interleaved with the calibration
+        # microbench: one-sided noise on a shared box (other tenants only
+        # slow us down), so the max is the estimator for BOTH, and both
+        # sample the same interference regime
         for _ in range(3):
+            micro = max(micro, _memcrc_mbps())
             t0 = time.perf_counter()
             n = 0
             while time.perf_counter() - t0 < 0.5:
@@ -104,9 +158,12 @@ def test_criteo_decode_hash_pack_floor(tmp_path):
                 )
                 n += hb["packed"].shape[0]
             best = max(best, n / (time.perf_counter() - t0))
-    assert best >= FLOOR, (
+    floor = _calibrated_floor("TFR_PERF_FLOOR_EX_S", _REF_CRITEO_RATIO, micro)
+    best *= _SELFTEST_SCALE
+    assert best >= floor, (
         f"device-free decode+hash+pack throughput {best:,.0f} ex/s fell "
-        f"below the floor {FLOOR:,.0f} ex/s — decode-path regression "
+        f"below the calibrated floor {floor:,.0f} ex/s (microbench "
+        f"{micro:,.0f} MB/s) — decode-path regression "
         "(native disabled? turbo cache broken? per-batch copies?)"
     )
 
@@ -168,10 +225,12 @@ def test_sequence_pad_bf16_floor(tmp_path):
         recordType="SequenceExample",
     )
     best = 0.0
+    micro = 0.0
     with ds.batches() as it:
         for _ in range(3):
             host_batch_from_columnar(next(it), ds.schema, pad_to=pad_to, cast=cast)
         for _ in range(3):
+            micro = max(micro, _memcrc_mbps())
             t0 = time.perf_counter()
             n = 0
             while time.perf_counter() - t0 < 0.5:
@@ -181,8 +240,11 @@ def test_sequence_pad_bf16_floor(tmp_path):
                 n += hb["frames"].shape[0]
             best = max(best, n / (time.perf_counter() - t0))
     assert hb["frames"].dtype == ml_dtypes.bfloat16
-    assert best >= SEQ_FLOOR, (
+    floor = _calibrated_floor("TFR_SEQ_PERF_FLOOR_EX_S", _REF_SEQ_RATIO, micro)
+    best *= _SELFTEST_SCALE
+    assert best >= floor, (
         f"SequenceExample decode+pad+bf16 throughput {best:,.0f} ex/s fell "
-        f"below the floor {SEQ_FLOOR:,.0f} ex/s — ragged^2 path regression "
+        f"below the calibrated floor {floor:,.0f} ex/s (microbench "
+        f"{micro:,.0f} MB/s) — ragged^2 path regression "
         "(fused native pad lost? per-row padding reintroduced?)"
     )

@@ -16,6 +16,7 @@ Usage::
     tools/tfrecord_doctor.py cache CACHE_DIR              # epoch-cache audit
     tools/tfrecord_doctor.py cache --evict-stale CACHE_DIR
     tools/tfrecord_doctor.py report DATA_DIR              # bottleneck doctor
+    tools/tfrecord_doctor.py tune DATA_DIR                # offline autotune
 
 The ``report`` subcommand is the bottleneck doctor: it runs N batches of
 the real pipeline with the flight recorder on (tpu_tfrecord.telemetry)
@@ -27,6 +28,15 @@ straggler ratio (decode p99/p50) and the producer/consumer bound-ness
 verdict — "is this pipeline decode-bound or is the consumer the
 bottleneck?" answered without attaching a profiler. ``--trace-out
 FILE.json`` additionally saves the Chrome trace (open in Perfetto).
+
+The ``tune`` subcommand runs the closed-loop autotuner
+(tpu_tfrecord.autotune) offline: it reads the real pipeline with
+``autotune="on"`` for ``--seconds``, letting the controller climb from the
+starting knobs, then prints one ``{"event": "tune_step", ...}`` line per
+controller decision (the convergence trajectory) and a final
+``{"event": "tune", ...}`` line with the converged knob set and the
+throughput it reached — the values to bake into a fixed-knob production
+config for this box/dataset pair.
 
 The ``cache`` subcommand audits a columnar epoch cache directory
 (tpu_tfrecord.cache): one ``{"event": "cache_entry", ...}`` line per entry
@@ -360,6 +370,87 @@ def report_main(argv: List[str]) -> int:
     return 0
 
 
+def tune_main(argv: List[str]) -> int:
+    """The ``tune`` subcommand: run the autotune loop offline and print
+    the converged knob set. Exit 0 = tuned (even if nothing moved);
+    2 = the dataset could not be read at all."""
+    ap = argparse.ArgumentParser(
+        prog="tfrecord_doctor tune",
+        description="Offline autotune: converge the pipeline knobs on a "
+        "real read and print the result",
+    )
+    ap.add_argument("data_dir", help="dataset directory (or shard glob)")
+    ap.add_argument(
+        "--seconds", type=float, default=5.0,
+        help="how long to let the controller climb (default 5)",
+    )
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument(
+        "--workers", type=int, default=1,
+        help="starting decode workers (default 1: climb from the floor)",
+    )
+    ap.add_argument(
+        "--interval", type=float, default=0.25,
+        help="controller tick interval in seconds (default 0.25)",
+    )
+    args = ap.parse_args(argv)
+
+    import time
+
+    from tpu_tfrecord.io.dataset import TFRecordDataset
+    from tpu_tfrecord.metrics import METRICS
+
+    def emit(obj: Dict) -> None:
+        sys.stdout.write(json.dumps(obj, sort_keys=True) + "\n")
+
+    METRICS.reset()
+    rows = 0
+    tuner = None
+    try:
+        ds = TFRecordDataset(
+            args.data_dir,
+            batch_size=args.batch_size,
+            num_workers=args.workers,
+            drop_remainder=False,
+            # finite epoch bound so a zero-record dataset terminates
+            # instead of spinning; any real dataset re-epochs far past
+            # --seconds before exhausting it
+            num_epochs=10_000,
+            autotune="on",
+            autotune_interval_s=args.interval,
+        )
+        with ds.batches() as it:
+            tuner = it.autotune
+            # the clock starts at the read loop, not at dataset
+            # construction: shard discovery/opens must not deflate the
+            # rows_per_sec a reader bakes into a production config
+            t0 = time.perf_counter()
+            deadline = t0 + args.seconds
+            for cb in it:
+                rows += cb.num_rows
+                if time.perf_counter() >= deadline:
+                    break
+            elapsed = time.perf_counter() - t0
+    except Exception as e:  # unreadable dataset, not a slow one
+        emit({"event": "error", "path": args.data_dir, "error": str(e)})
+        return 2
+    for decision in tuner.log:
+        emit({"event": "tune_step", **decision})
+    emit(
+        {
+            "event": "tune",
+            "path": args.data_dir,
+            "seconds": round(elapsed, 3),
+            "rows": rows,
+            "rows_per_sec": round(rows / elapsed, 1) if elapsed else None,
+            "start_workers": args.workers,
+            "adjustments": len(tuner.log),
+            "knobs": tuner.snapshot(),
+        }
+    )
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -367,6 +458,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return cache_main(argv[1:])
     if argv and argv[0] == "report":
         return report_main(argv[1:])
+    if argv and argv[0] == "tune":
+        return tune_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="tfrecord_doctor", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,

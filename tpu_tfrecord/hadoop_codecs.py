@@ -288,17 +288,30 @@ def _lz4_decompress_py(data: bytes, expected: Optional[int] = None) -> bytes:
     return bytes(out)
 
 
+# The native lz4 encoder's match table stores int32 positions: a single
+# call beyond this is out of contract (positions would alias past 2 GiB —
+# matches are byte-verified so output stays VALID, but the ratio collapses
+# silently). Guarded here as well as in _native so the dispatch can never
+# silently degrade; module-level so tests can shrink it and pin the
+# fallback without allocating 2 GiB.
+LZ4_NATIVE_MAX_BYTES = 2**31 - 1
+
+
 def lz4_compress(data: bytes) -> bytes:
     """Encode one lz4 block. Dispatch: in-repo native greedy-matching
     encoder (real compression — round 4) -> pure-Python literals-only
     fallback (legal per the block spec — the last sequence carries only
-    literals)."""
+    literals). Inputs past ``LZ4_NATIVE_MAX_BYTES`` (the native match
+    table's int32 position contract) skip the native path entirely;
+    Hadoop block framing (``compress_hadoop_blocks``/``HadoopBlockFile``)
+    never gets here — it frames in 256 KiB blocks."""
     try:
         from tpu_tfrecord import _native
 
-        out = _native.lz4_compress(data)
-        if out is not None:
-            return out
+        if len(data) <= LZ4_NATIVE_MAX_BYTES:
+            out = _native.lz4_compress(data)
+            if out is not None:
+                return out
     except ImportError:
         pass
     n = len(data)

@@ -50,12 +50,29 @@ from tpu_tfrecord.metrics import METRICS, log_salvage_event, timed
 from tpu_tfrecord.options import TFRecordOptions
 from tpu_tfrecord.retry import RetryPolicy
 from tpu_tfrecord.schema import StructType
-from tpu_tfrecord.stall import StallError, WatchdogError, guard_from_options
+from tpu_tfrecord.stall import (
+    StallError,
+    StallGuard,
+    WatchdogError,
+    guard_from_options,
+)
 
 
 # Injectable opener for the mmap fast path (it bypasses wire.open_compressed,
 # so fault-injection tests patch THIS seam).
 _open_local = open
+
+
+class _ResizableQueue(queue.Queue):
+    """queue.Queue whose maxsize can change while producers/consumers are
+    live — the prefetch queue under autotune. Growing wakes blocked
+    putters immediately; shrinking below the current fill simply blocks
+    new puts until the consumer drains (items are never dropped)."""
+
+    def resize(self, maxsize: int) -> None:
+        with self.mutex:
+            self.maxsize = max(1, int(maxsize))
+            self.not_full.notify_all()
 
 
 def _noop_hint(_pos: int) -> None:
@@ -266,6 +283,12 @@ class TFRecordDataset:
         # set, so the default hot path pays nothing. The watchdog
         # (watchdog_timeout_ms) is wired separately in _parallel_chunks.
         self._stall_guard = guard_from_options(self.options)
+        if self._stall_guard is None and self.options.autotune == "on":
+            # autotune derives hedge/deadline thresholds from observed
+            # p99s; an empty guard (no thresholds yet — opens run direct,
+            # reads unwrapped) gives the controller a place to install
+            # them; streams opened after an install are guarded
+            self._stall_guard = StallGuard()
         # Sliding posix_fadvise(WILLNEED) window for local shards (0 = off):
         # the kernel fetches ahead ASYNCHRONOUSLY while the C++ decoder
         # chews the current chunk, so cold (non-page-cache-resident) reads
@@ -844,16 +867,22 @@ class TFRecordDataset:
 
         yield from self._retrying(attempt)
 
-    def _chunk_stream(self, state: IteratorState, stop_event=None) -> Iterator[tuple]:
+    def _chunk_stream(
+        self, state: IteratorState, stop_event=None, control=None
+    ) -> Iterator[tuple]:
         """Yield (chunk, epoch, position, start_offset) from the resume point
         onward. With ``num_workers > 1`` shards decode in a thread pool (the
         native decoder releases the GIL) and chunks are re-emitted in exact
-        stream order; memory is bounded by num_workers in-flight shards."""
-        if self.num_workers <= 1:
+        stream order; memory is bounded by num_workers in-flight shards.
+        With a ``control`` (autotune.PipelineControl) the pool path is
+        taken even at num_workers=1 so the pool can grow mid-epoch."""
+        if self.num_workers <= 1 and control is None:
             for epoch, pos, shard_idx, skip in self._shard_tasks(state):
                 yield from self._decode_shard(epoch, pos, shard_idx, skip)
             return
-        yield from _parallel_chunks(self, state, stop_event or threading.Event())
+        yield from _parallel_chunks(
+            self, state, stop_event or threading.Event(), control
+        )
 
     def _attach_partition_chunk(self, chunk: ColumnarBatch, cursor: int) -> None:
         """Partition values are constant within a shard: materialize them as
@@ -956,6 +985,7 @@ def _producer_loop(
     start: IteratorState,
     out_queue: queue.Queue,
     stop: threading.Event,
+    control=None,
 ) -> None:
     """Background batch producer (module-level so the thread never pins the
     consumer-side iterator object)."""
@@ -1004,13 +1034,13 @@ def _producer_loop(
         return False
 
     if ds.shuffle_window:
-        _shuffled_producer_loop(ds, start, out_queue, stop)
+        _shuffled_producer_loop(ds, start, out_queue, stop, control)
         return
     try:
         # pending: [chunk, consumed_rows, epoch, cursor, chunk_start]
         pending: List[list] = []
         avail = 0
-        for chunk, epoch, cursor, chunk_start in ds._chunk_stream(start, stop):
+        for chunk, epoch, cursor, chunk_start in ds._chunk_stream(start, stop, control):
             if stop.is_set():
                 return
             if chunk.num_rows == 0:
@@ -1043,6 +1073,7 @@ def _shuffled_producer_loop(
     start: IteratorState,
     out_queue: queue.Queue,
     stop: threading.Event,
+    control=None,
 ) -> None:
     """Windowed row shuffle: accumulate ``shuffle_window`` batches worth of
     rows, permute them (seeded by the window's start position), emit
@@ -1113,7 +1144,9 @@ def _shuffled_producer_loop(
             return True
 
         stream_end = win_start  # position after the last consumed row
-        for chunk, epoch, cursor, chunk_start in ds._chunk_stream(win_start, stop):
+        for chunk, epoch, cursor, chunk_start in ds._chunk_stream(
+            win_start, stop, control
+        ):
             if stop.is_set():
                 return
             consumed = 0
@@ -1170,16 +1203,23 @@ class _ShardJob:
 
 
 def _parallel_chunks(
-    ds: TFRecordDataset, state: IteratorState, stop: threading.Event
+    ds: TFRecordDataset, state: IteratorState, stop: threading.Event,
+    control=None,
 ) -> Iterator[tuple]:
-    """Ordered parallel shard decode, with an optional watchdog.
+    """Ordered parallel shard decode, with an optional watchdog and an
+    optionally LIVE-RESIZABLE pool.
 
     A dispatcher enumerates shard tasks lazily (epochs may be infinite) and
     hands each to the worker pool; every task owns a small bounded queue, so
-    backpressure is per shard and total buffering is bounded by
-    ``num_workers`` in-flight shards. The emitter drains task queues in the
-    exact task order, so output is identical to the sequential stream —
-    checkpoint state and batch contents do not depend on num_workers.
+    backpressure is per shard and total buffering is bounded by the
+    in-flight shard cap. The emitter drains task queues in the exact task
+    order, so output is identical to the sequential stream — checkpoint
+    state and batch contents do not depend on the worker count, which is
+    exactly what makes the pool safely resizable mid-epoch: with a
+    ``control`` (autotune.PipelineControl), growth spawns extra worker
+    threads that pull from the same task queue, and shrink lets surplus
+    workers retire between shards (``should_exit``) — ordering, chunk
+    boundaries, and resume positions never change.
 
     With ``watchdog_timeout_ms`` set, a watchdog thread scans the in-flight
     jobs' progress heartbeats: a worker that goes silent past the timeout
@@ -1189,9 +1229,13 @@ def _parallel_chunks(
     instead of the consumer blocking on the dead worker's queue forever.
     The emitter applies ``on_stall`` to the failed job after draining the
     chunks it produced before wedging."""
-    n_workers = ds.num_workers
-    task_q: queue.Queue = queue.Queue(maxsize=n_workers)
-    order_q: queue.Queue = queue.Queue(maxsize=n_workers + 1)
+    n_workers = ds.num_workers if control is None else control.workers
+    # queue capacities are fixed at construction: under a control they are
+    # sized to the pool CEILING so later growth is not strangled by a
+    # queue sized for the starting worker count
+    cap = n_workers if control is None else max(control.max_workers, n_workers)
+    task_q: queue.Queue = queue.Queue(maxsize=cap)
+    order_q: queue.Queue = queue.Queue(maxsize=cap + 1)
     END = object()
     clock = time.monotonic
     wd_ms = ds.options.watchdog_timeout_ms
@@ -1222,43 +1266,75 @@ def _parallel_chunks(
                     return
             put_checked(order_q, END)
         finally:
-            for _ in range(n_workers):
-                if not put_checked(task_q, END):
-                    break
+            if control is not None:
+                # dynamic pool: ONE sentinel, re-put by each worker that
+                # sees it — terminates any number of workers
+                put_checked(task_q, END)
+            else:
+                for _ in range(n_workers):
+                    if not put_checked(task_q, END):
+                        break
 
     def worker() -> None:
-        while not stop.is_set():
-            try:
-                job = task_q.get(timeout=0.1)
-            except queue.Empty:
-                continue
-            if job is END:
-                return
-            job.beat = clock()
-            with inflight_lock:
-                inflight[id(job)] = job
-                METRICS.gauge("read.inflight_workers", len(inflight))
-            try:
-                try:
-                    for item in ds._decode_shard(*job.task):
-                        if not put_checked(job.out, ("chunk", item), job=job):
-                            return
-                        job.beat = clock()
-                    if job.wedged:
-                        return  # declared dead: a replacement already runs
-                    # job= keeps the heartbeat fresh while blocked on a
-                    # full queue — a DONE shard backpressured behind the
-                    # emitter must never look wedged
-                    put_checked(job.out, ("end", None), job=job)
-                except BaseException as e:
-                    if job.wedged:
-                        return
-                    put_checked(job.out, ("error", e), job=job)
+        permitted = False
+        replaced = False  # declared wedged: the watchdog's replacement
+        # already took over this slot, so this thread's (possibly very
+        # late) exit must NOT debit the pool books a second time
+        try:
+            while not stop.is_set():
+                if control is not None and control.should_exit():
+                    permitted = True  # pool over target: retire between shards
                     return
-            finally:
+                try:
+                    job = task_q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                if job is END:
+                    if control is not None:
+                        put_checked(task_q, END)  # pass the sentinel on
+                    return
+                job.beat = clock()
                 with inflight_lock:
-                    inflight.pop(id(job), None)
+                    inflight[id(job)] = job
                     METRICS.gauge("read.inflight_workers", len(inflight))
+                try:
+                    try:
+                        for item in ds._decode_shard(*job.task):
+                            if not put_checked(job.out, ("chunk", item), job=job):
+                                replaced = job.wedged
+                                return
+                            job.beat = clock()
+                        if job.wedged:
+                            replaced = True
+                            return  # declared dead: a replacement already runs
+                        # job= keeps the heartbeat fresh while blocked on a
+                        # full queue — a DONE shard backpressured behind the
+                        # emitter must never look wedged
+                        put_checked(job.out, ("end", None), job=job)
+                    except BaseException as e:
+                        if job.wedged:
+                            replaced = True
+                            return
+                        put_checked(job.out, ("error", e), job=job)
+                        return
+                finally:
+                    with inflight_lock:
+                        inflight.pop(id(job), None)
+                        METRICS.gauge("read.inflight_workers", len(inflight))
+                        if job.wedged:
+                            # the watchdog declared THIS job wedged (under
+                            # this lock) before we removed it: a
+                            # replacement is (being) spawned for our slot,
+                            # so this thread must retire even though it
+                            # may have just finished the job normally —
+                            # two unbooked threads working one slot would
+                            # skew the pool books
+                            replaced = True
+                if replaced:
+                    return
+        finally:
+            if control is not None and not replaced:
+                control.note_exit(permitted)
 
     def watchdog() -> None:
         interval = max(0.01, wd_timeout / 4.0)
@@ -1268,15 +1344,22 @@ def _parallel_chunks(
                 return
             now = clock()
             with inflight_lock:
+                # wedged is DECIDED under the lock, against jobs still in
+                # flight: a worker finishing a job pops it (and observes
+                # wedged) in its own locked finally, so exactly one side
+                # wins — a job can complete normally or be declared
+                # wedged+replaced, never both (racing the mark after the
+                # pop let a just-finished worker keep running unaware it
+                # had been replaced, skewing the autotune pool books)
                 stale = [
                     j
                     for j in inflight.values()
                     if not j.wedged and now - j.beat > wd_timeout
                 ]
                 for j in stale:
+                    j.wedged = True
                     inflight.pop(id(j), None)
             for job in stale:
-                job.wedged = True
                 path = ds.shards[job.task[2]].path
                 job.failed = WatchdogError(
                     f"shard worker made no progress for "
@@ -1290,15 +1373,30 @@ def _parallel_chunks(
                 )
                 # the wedged thread can never be cancelled (blocked in a
                 # C-level read); a fresh worker takes over the task queue
-                # so the epoch keeps decoding
+                # so the epoch keeps decoding. Pool books under a control:
+                # the replacement inherits the wedged thread's slot — it
+                # is NOT booked as a spawn, and the wedged thread's own
+                # eventual exit is suppressed (`replaced` in worker()) —
+                # so the accounted pool always equals the PRODUCTIVE
+                # worker count and should_exit never retires a healthy
+                # worker to pay for a zombie
                 threading.Thread(target=worker, daemon=True).start()
 
     threads = [threading.Thread(target=dispatcher, daemon=True)]
-    threads += [threading.Thread(target=worker, daemon=True) for _ in range(n_workers)]
+    if control is None:
+        threads += [
+            threading.Thread(target=worker, daemon=True) for _ in range(n_workers)
+        ]
     if wd_timeout is not None:
         threads.append(threading.Thread(target=watchdog, daemon=True))
     for t in threads:
         t.start()
+    if control is not None:
+        # the control owns worker lifecycle: this brings the pool up to
+        # its current target and lets set_workers() grow it later
+        control.bind_spawn(
+            lambda: threading.Thread(target=worker, daemon=True).start()
+        )
 
     while not stop.is_set():
         try:
@@ -1341,17 +1439,45 @@ class CheckpointableIterator:
         self._start = state
         self._consumed_state = state
         self._finished = None  # None=running, True=exhausted, Exception=failed
-        self._queue: queue.Queue = queue.Queue(maxsize=max(1, dataset.prefetch))
+        self._queue: queue.Queue = _ResizableQueue(maxsize=max(1, dataset.prefetch))
         self._stop = threading.Event()
         # Bound-ness telemetry: EMA of the prefetch queue's fill fraction,
         # sampled by the consumer at each batch get (telemetry.Pulse reads
         # the gauge; boundness_verdict interprets it).
         self._occupancy = telemetry.OccupancyEma(telemetry.OCCUPANCY_GAUGE)
+        # Closed-loop autotuning (tpu_tfrecord.autotune): a PipelineControl
+        # exposes THIS iterator's live knobs (decode pool, prefetch queue,
+        # readahead window, stall-guard thresholds); the controller runs
+        # as a pulse observer, so autotune="on" implies a pulse (at
+        # pulse_interval_s if configured, else autotune_interval_s).
+        self._control = None
+        self.autotune = None
+        pulse_interval = dataset.options.pulse_interval_s
+        if dataset.options.autotune == "on":
+            from tpu_tfrecord import autotune as _autotune
+
+            self._control = _autotune.PipelineControl(
+                workers=dataset.num_workers,
+                queue=self._queue,
+                dataset=dataset,
+                guard=dataset._stall_guard,
+            )
+            if pulse_interval is None:
+                pulse_interval = (
+                    dataset.options.autotune_interval_s
+                    or _autotune.DEFAULT_INTERVAL_S
+                )
+            self.autotune = _autotune.AutotuneController(
+                self._control, interval_s=pulse_interval
+            )
         self._pulse = None
-        if dataset.options.pulse_interval_s is not None:
+        if pulse_interval is not None:
             from tpu_tfrecord.telemetry import Pulse
 
-            self._pulse = Pulse(dataset.options.pulse_interval_s).start()
+            self._pulse = Pulse(pulse_interval)
+            if self.autotune is not None:
+                self._pulse.add_observer(self.autotune.on_pulse)
+            self._pulse.start()
             # like the stop-event finalizer below: an abandoned iterator
             # must not leave its pulse thread ticking forever (the
             # finalizer holds the Pulse, never this object)
@@ -1367,7 +1493,7 @@ class CheckpointableIterator:
         self._finalizer = weakref.finalize(self, self._stop.set)
         self._thread = threading.Thread(
             target=_producer_loop,
-            args=(dataset, state, self._queue, self._stop),
+            args=(dataset, state, self._queue, self._stop, self._control),
             daemon=True,
         )
         self._thread.start()

@@ -99,8 +99,11 @@ class Metrics:
         ``cache.evictions``, ``cache.corrupt_fallbacks`` — mmap-served
         chunk throughput lands in the ``cache.serve`` stage), the
         per-stage error counters ``<stage>.errors`` (bumped by ``timed``
-        when an exception propagates through it), and the backpressure
-        counters ``read.backpressure_waits``/``write.backpressure_waits``.
+        when an exception propagates through it), the backpressure
+        counters ``read.backpressure_waits``/``write.backpressure_waits``,
+        and the autotune decision counter ``autotune.adjustments`` (each
+        controller knob move — the current knob VALUES live in the
+        ``autotune.<knob>`` gauges).
 
         INSTANTANEOUS values (queue depths, occupancies, in-flight worker
         counts) belong in ``gauge()``, not here — a counter only goes up.

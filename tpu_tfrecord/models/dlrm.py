@@ -190,6 +190,37 @@ def sparse_opt_init(params, cfg: DLRMConfig, tx) -> SparseEmbOptState:
     )
 
 
+# Largest F*V for which a flat int32 (f*V + v) dedup key cannot wrap (the
+# default JAX index dtype with x64 disabled). Module-level so tests can
+# shrink it and pin both sort paths against each other at test scale.
+_FLAT_KEY_MAX = 2**31 - 1
+
+
+def _dedup_sort(f_flat, v_flat, vocab: int, force_pairs: bool = False):
+    """Sorted grouping for the dedup-first embedding update: returns
+    (order, sf, sv, run_start) where ``order`` sorts the flattened (f, v)
+    element list lexicographically, ``sf``/``sv`` are the sorted index
+    pairs, and ``run_start`` marks each duplicate group's first element.
+
+    Two equivalent paths: flat int32 keys (one argsort — the fast common
+    case) while F*V fits int32, and a lexicographic (f, v) pair sort
+    beyond that — int32 flat keys would silently WRAP for F*V > 2^31,
+    merging unrelated rows into one dedup group and corrupting their
+    updates, and int64 keys are unavailable with x64 disabled. Both sorts
+    are stable over the same total order (v < vocab), so they produce the
+    identical permutation (pinned in tests/test_model.py)."""
+    if force_pairs:
+        order = jnp.lexsort((v_flat, f_flat))
+    else:
+        order = jnp.argsort(v_flat + f_flat * vocab)
+    sf = f_flat[order]
+    sv = v_flat[order]
+    run_start = jnp.concatenate(
+        [jnp.ones((1,), bool), (sf[1:] != sf[:-1]) | (sv[1:] != sv[:-1])]
+    )
+    return order, sf, sv, run_start
+
+
 def sparse_train_step(
     params,
     opt_state: SparseEmbOptState,
@@ -239,25 +270,14 @@ def sparse_train_step(
     dense_params = jax.tree.map(lambda p, u: p + u, dense_params, updates)
     g_rows = g_rows.astype(jnp.float32)
     fdim, vocab = cfg.num_categorical, cfg.vocab_size
-    if fdim * vocab > jnp.iinfo(jnp.int32).max:
-        # int32 flat keys (the default JAX index dtype with x64 disabled)
-        # would silently wrap for F*V > 2^31, merging unrelated rows into
-        # one dedup group and corrupting their updates. Vocabularies that
-        # large should shard the table (param_shardings model axis) or
-        # enable jax_enable_x64 and widen the key computation.
-        raise ValueError(
-            f"sparse_train_step: num_categorical * vocab_size = "
-            f"{fdim * vocab} exceeds int32 range for flat dedup keys"
-        )
     d = g_rows.shape[-1]
     n = idx.shape[0] * fdim
-    keys = (idx + f_ix * vocab).reshape(n)                  # [N] flat (f, v)
-    order = jnp.argsort(keys)
-    skeys = keys[order]
-    sg = g_rows.reshape(n, d)[order]
-    run_start = jnp.concatenate(
-        [jnp.ones((1,), bool), skeys[1:] != skeys[:-1]]
+    f_flat = jnp.broadcast_to(f_ix, idx.shape).reshape(n)   # [N] feature id
+    v_flat = idx.reshape(n)                                 # [N] vocab row
+    order, sf, sv, run_start = _dedup_sort(
+        f_flat, v_flat, vocab, force_pairs=fdim * vocab > _FLAT_KEY_MAX
     )
+    sg = g_rows.reshape(n, d)[order]
     rid = jnp.cumsum(run_start) - 1                         # run id per element
     # per-element view of its duplicate group's summed gradient and size
     g_sum = jax.ops.segment_sum(
@@ -271,9 +291,7 @@ def sparse_train_step(
     # Scatter with (f, v) index PAIRS, never a flattened [F*V] view: the
     # table/accum keep their [F, V@model, D] layout, so GSPMD scatters into
     # the model-sharded V axis instead of all-gathering a reshaped table
-    # (sorted keys => (f, v) pairs are lexicographically sorted too).
-    sf = skeys // vocab
-    sv = skeys - sf * vocab
+    # (both _dedup_sort paths emit (f, v) in lexicographic order).
     accum = opt_state.accum.at[sf, sv].add(ms_share, indices_are_sorted=True)
     # post-accumulation scale, shared by a row's duplicates by construction
     scale = embed_lr * jax.lax.rsqrt(accum[sf, sv] + embed_eps)     # [N]

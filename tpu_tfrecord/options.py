@@ -129,6 +129,18 @@ class TFRecordOptions:
       - telemetry_port: serve a Prometheus text endpoint (``/metrics``)
         on 127.0.0.1:PORT via a stdlib HTTP daemon thread (0 = an
         ephemeral port). None (default) = no endpoint.
+      - autotune: closed-loop knob tuning (tpu_tfrecord.autotune).
+        ``"off"`` (default) keeps every knob static; ``"on"`` runs a
+        controller at pulse boundaries that resizes the decode worker
+        pool and prefetch queue from the producer/consumer bound-ness
+        verdict, retargets readahead from observed IO bandwidth, and
+        derives hedge/deadline thresholds from observed open/read p99 —
+        with hysteresis, per-knob clamps, and a cooldown. Row output and
+        checkpoint/resume stay byte-identical to any fixed-knob run.
+      - autotune_interval_s: the controller's cadence when ``autotune``
+        is on and no ``pulse_interval_s`` is set (default 1.0s; a
+        configured pulse interval wins — the controller always runs at
+        pulse boundaries).
     """
 
     record_type: RecordType = RecordType.EXAMPLE
@@ -154,6 +166,8 @@ class TFRecordOptions:
     trace: str = "off"
     pulse_interval_s: Optional[float] = None
     telemetry_port: Optional[int] = None
+    autotune: str = "off"
+    autotune_interval_s: Optional[float] = None
 
     _KNOWN_KEYS = (
         "recordType",
@@ -198,6 +212,9 @@ class TFRecordOptions:
         "pulseIntervalS",
         "telemetry_port",
         "telemetryPort",
+        "autotune",
+        "autotune_interval_s",
+        "autotuneIntervalS",
     )
 
     ON_CORRUPT_POLICIES = ("raise", "skip_record", "skip_shard")
@@ -205,6 +222,7 @@ class TFRecordOptions:
     ON_STALL_POLICIES = ("raise", "skip_shard")
     CACHE_MODES = ("off", "auto")
     TRACE_MODES = ("off", "on")
+    AUTOTUNE_MODES = ("off", "on")
 
     @staticmethod
     def from_map(options: Optional[Mapping[str, Any]] = None, **kwargs: Any) -> "TFRecordOptions":
@@ -333,6 +351,19 @@ class TFRecordOptions:
                 raise ValueError(
                     "telemetry_port must be in [0, 65535] (0 = ephemeral)"
                 )
+        autotune = str(merged.pop("autotune", "off") or "off").strip().lower()
+        if autotune not in TFRecordOptions.AUTOTUNE_MODES:
+            raise ValueError(
+                f"autotune must be one of {TFRecordOptions.AUTOTUNE_MODES}, "
+                f"got {autotune!r}"
+            )
+        autotune_interval_s = merged.pop(
+            "autotune_interval_s", merged.pop("autotuneIntervalS", None)
+        )
+        if autotune_interval_s is not None:
+            autotune_interval_s = float(autotune_interval_s)
+            if autotune_interval_s <= 0:
+                raise ValueError("autotune_interval_s must be > 0 (or None)")
         if merged:
             import difflib
 
@@ -372,6 +403,8 @@ class TFRecordOptions:
             trace=trace,
             pulse_interval_s=pulse_interval_s,
             telemetry_port=telemetry_port,
+            autotune=autotune,
+            autotune_interval_s=autotune_interval_s,
         )
 
     def with_schema(self, schema: StructType) -> "TFRecordOptions":

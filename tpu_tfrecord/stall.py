@@ -194,11 +194,17 @@ class GuardedReadStream:
         metrics: Metrics = METRICS,
         io_chunk: int = 4 << 20,
         pool: Optional[_WorkerPool] = None,
+        guard: "Optional[StallGuard]" = None,
     ):
         self._fh = fh
         self._path = path
-        self._deadline = read_deadline
-        self._hedge_after = hedge_after if reopen is not None else None
+        self._fixed_deadline = read_deadline
+        self._fixed_hedge_after = hedge_after
+        # threshold source (autotune): when a guard is given, every fetch
+        # reads ITS current read_deadline/hedge_after — so a controller
+        # update (StallGuard.update_thresholds) takes effect on live
+        # streams, not just the next shard open
+        self._guard = guard
         self._reopen = reopen
         self._metrics = metrics
         self._io_chunk = max(1, int(io_chunk))
@@ -209,6 +215,20 @@ class GuardedReadStream:
         self._buf_pos = 0
         self._wedged = False
         self._closed = False
+
+    # -- live thresholds -----------------------------------------------------
+
+    @property
+    def _deadline(self) -> Optional[float]:
+        g = self._guard
+        return g.read_deadline if g is not None else self._fixed_deadline
+
+    @property
+    def _hedge_after(self) -> Optional[float]:
+        if self._reopen is None:
+            return None  # no backup opener: hedging impossible
+        g = self._guard
+        return g.hedge_after if g is not None else self._fixed_hedge_after
 
     # -- the guarded fetch ---------------------------------------------------
 
@@ -403,6 +423,26 @@ class StallGuard:
         # idle threads (ShardReader builds a guard per shard)
         self._pool = _SHARED_POOL
 
+    # -- controller-updated thresholds (autotune) ----------------------------
+
+    def update_thresholds(
+        self,
+        read_deadline_ms: Optional[float] = None,
+        open_deadline_ms: Optional[float] = None,
+        hedge_after_ms: Optional[float] = None,
+    ) -> None:
+        """Retarget the guard's thresholds (milliseconds; None leaves a
+        knob untouched). Live streams pick up read_deadline/hedge_after on
+        their next fetch (GuardedReadStream reads them through the guard);
+        open_deadline applies to the next open. Plain float attribute
+        writes — atomic under the GIL, so no lock is needed for readers."""
+        if read_deadline_ms is not None:
+            self.read_deadline = read_deadline_ms / 1000.0
+        if open_deadline_ms is not None:
+            self.open_deadline = open_deadline_ms / 1000.0
+        if hedge_after_ms is not None:
+            self.hedge_after = hedge_after_ms / 1000.0
+
     # -- open-side deadline --------------------------------------------------
 
     def call_open(self, fn: Callable, path: str):
@@ -473,6 +513,7 @@ class StallGuard:
                 metrics=self.metrics,
                 io_chunk=self.io_chunk,
                 pool=self._pool,
+                guard=self,  # live thresholds: autotune updates apply mid-stream
             )
         return wire.wrap_codec(path, "rb", codec, guarded)
 

@@ -35,7 +35,11 @@ profiler. Four pieces:
   bound-ness verdict. Opt-in via ``TFRecordOptions(pulse_interval_s=...)``;
   an optional stdlib-HTTP Prometheus text endpoint
   (``TFRecordOptions(telemetry_port=...)`` / ``ensure_exporter``) serves
-  the same registry for scraping.
+  the same registry for scraping. Pulse ticks are also the pipeline's
+  ACTUATION points: registered observers (``add_observer``) see each
+  payload before it is emitted and may merge fields into the line — the
+  closed-loop autotuner (tpu_tfrecord.autotune) runs this way, so every
+  knob decision lands in the same trace as the interval it was made from.
 
 - **Bound-ness verdict** (``boundness_verdict``): computed from the
   prefetch queue's average fill fraction, sampled by the consumer. A queue
@@ -485,6 +489,20 @@ class Pulse:
         self._prev_t = clock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._observers: List[Callable[[Dict[str, Any]], Optional[Dict]]] = []
+
+    def add_observer(
+        self, fn: Callable[[Dict[str, Any]], Optional[Dict]]
+    ) -> "Pulse":
+        """Register a per-tick observer. Each tick, after the payload is
+        computed and before it is emitted, every observer is called with
+        the payload; a returned dict is merged into the emitted line. The
+        autotune controller runs this way (its decisions land in the same
+        pulse line that carries the interval they were made from).
+        Observer exceptions are swallowed — telemetry (and tuning riding
+        on it) must never take the pipeline down."""
+        self._observers.append(fn)
+        return self
 
     def start(self) -> "Pulse":
         if self._thread is None:
@@ -556,6 +574,20 @@ class Pulse:
             "quantiles": quantiles,
             "verdict": boundness_verdict(gauges.get(OCCUPANCY_GAUGE)),
         }
+        for fn in list(self._observers):
+            try:
+                extra = fn(payload)
+                if extra:
+                    payload.update(extra)
+            except Exception:
+                # observers must never take the pipeline down — but a
+                # crashing controller silently freezing the knobs must
+                # not be invisible either: the error counter lands in
+                # this very pulse's counters on the NEXT tick
+                try:
+                    self.metrics.count("pulse.observer_errors")
+                except Exception:
+                    pass
         self.emit(payload)
         return payload
 
