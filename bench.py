@@ -384,20 +384,77 @@ def _tracing_overhead(data_dir, schema, hash_buckets, pack) -> dict:
         traced.append(g)
         pair_pct.append((1.0 - g / b) * 100.0)
     best_b, best_g = max(base), max(traced)
+
+    # cluster-spool arm (ISSUE 7, same <=2% bar): the identical loop with
+    # TRACE on AND the telemetry spool ticking into a scratch dir — the
+    # full fleet-observed configuration a disaggregated worker would run
+    # with. One interleaved pair against a fresh baseline (the spool is a
+    # 1 Hz daemon-thread JSONL rewrite; it either costs ~nothing or the
+    # number says so).
+    import shutil
+    import tempfile
+
+    from tpu_tfrecord import fleet
+
+    spool_dir = tempfile.mkdtemp(prefix="tfr_bench_spool_")
+    try:
+
+        def run_spooled():
+            tm.RECORDER.clear()
+            tm.enable()
+            try:
+                return _host_side_throughput(
+                    data_dir, schema, hash_buckets, pack, seconds=seconds,
+                    trace="on", telemetry_spool_dir=spool_dir,
+                    telemetry_role="bench",
+                )
+            finally:
+                tm.disable()
+
+        # interleaved A/B, best-of-each — the same one-sided noise
+        # estimator as the trace arm above
+        b0, s0 = run(False), run_spooled()
+        # the second spooled run's spool object rewrites the (same-pid)
+        # spool file from scratch, so the aggregator only ever sees ITS
+        # lines — count the writes over the same window so the two
+        # corroborating fields below agree
+        writes_before_s1 = METRICS.counter("fleet.spool_writes")
+        s1, b1 = run_spooled(), run(False)
+        spool_base, spool_on = max(b0, b1), max(s0, s1)
+        fleet_snap = fleet.TelemetryAggregator(spool_dir).aggregate()
+        spool_info = {
+            "spool_baseline_eps": round(spool_base, 1),
+            "spool_enabled_eps": round(spool_on, 1),
+            "spool_overhead_pct": round(
+                (1.0 - spool_on / spool_base) * 100.0, 2
+            ),
+            "spool_snapshots": sum(p.seq for p in fleet_snap.processes),
+            "spool_writes_counted": METRICS.counter("fleet.spool_writes")
+            - writes_before_s1,
+        }
+    finally:
+        shutil.rmtree(spool_dir, ignore_errors=True)
+
     quantiles = tm.quantiles_ms(METRICS.quantiles())
     occ = METRICS.gauge_value(tm.OCCUPANCY_GAUGE)
+    ctx = tm.current_context()
     out = {
         "tracing_baseline_eps": round(best_b, 1),
         "tracing_enabled_eps": round(best_g, 1),
         "tracing_overhead_pct": round((1.0 - best_g / best_b) * 100.0, 2),
         "tracing_pair_median_pct": round(statistics.median(pair_pct), 2),
         "tracing_pair_pcts": [round(p, 2) for p in pair_pct],
+        **spool_info,
         "telemetry": {
             "quantiles": quantiles,
             "prefetch_occupancy": round(occ, 4) if occ is not None else None,
             "verdict": tm.boundness_verdict(occ),
             "spans_recorded": len(tm.RECORDER),
             "spans_dropped": tm.RECORDER.dropped,
+            # identity stamp: correlates this artifact with pulse lines,
+            # spool snapshots, and merged traces from the same run
+            "proc": {"host": ctx.host, "pid": ctx.pid, "role": ctx.role,
+                     "trace_id": ctx.trace_id},
         },
     }
     tm.RECORDER.clear()

@@ -1,0 +1,92 @@
+"""Worker process for cluster flight-recorder tests: one spooling reader.
+
+Spawned K times (concurrently) by tests/test_fleet.py and the
+tools/verify.sh fleet smoke. Each process joins the parent's trace via
+``TFR_TRACE_CONTEXT`` (telemetry.adopt_from_env), reads the shared
+dataset with the telemetry spool on, optionally saves its own Chrome
+trace, optionally lingers (heartbeating) so the parent can kill it
+mid-life, and prints one JSON line with its identity and per-process
+totals for the parent to check exact aggregation against.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("data_dir")
+    ap.add_argument("spool_dir")
+    ap.add_argument("--role", default="reader")
+    ap.add_argument("--trace-out", default=None)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--interval", type=float, default=0.1)
+    ap.add_argument(
+        "--linger", type=float, default=0.0,
+        help="keep spool heartbeats going this long after the read "
+        "(so a parent can SIGKILL a demonstrably-alive worker)",
+    )
+    args = ap.parse_args()
+
+    from tpu_tfrecord import fleet, telemetry
+    from tpu_tfrecord.io.dataset import TFRecordDataset
+    from tpu_tfrecord.metrics import METRICS
+    from tpu_tfrecord.schema import LongType, StringType, StructField, StructType
+
+    ctx = telemetry.adopt_from_env(role=args.role)
+    schema = StructType(
+        [StructField("id", LongType(), nullable=False), StructField("s", StringType())]
+    )
+    ds = TFRecordDataset(
+        args.data_dir,
+        batch_size=args.batch_size,
+        schema=schema,
+        drop_remainder=False,
+        num_epochs=args.epochs,
+        trace="on" if args.trace_out else "off",
+        telemetry_spool_dir=args.spool_dir,
+        spool_interval_s=args.interval,
+        telemetry_role=args.role,
+    )
+    rows = 0
+    # an explicit extra spool reference: heartbeats continue through the
+    # --linger window after the read (so a parent can SIGKILL a worker the
+    # spool still shows alive), and the release below lands the final
+    # cumulative snapshot even for trace-only exits
+    fleet.acquire_spool(args.spool_dir, role=args.role, interval_s=args.interval)
+    try:
+        with ds.batches() as it:
+            for cb in it:
+                rows += cb.num_rows
+        deadline = time.time() + args.linger
+        while time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        fleet.release_spool(args.spool_dir)
+    if args.trace_out:
+        telemetry.RECORDER.save_chrome_trace(args.trace_out)
+    decode = METRICS.stage("decode")
+    print(
+        json.dumps(
+            {
+                "pid": os.getpid(),
+                "host": ctx.host,
+                "role": ctx.role,
+                "trace_id": ctx.trace_id,
+                "parent_span_id": ctx.parent_span_id,
+                "rows": rows,
+                "decode_records": decode.records,
+                "spool_path": fleet.spool_path(args.spool_dir, ctx),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

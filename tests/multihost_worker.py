@@ -27,6 +27,11 @@ def main() -> None:
     distributed.initialize(coord, num_procs, pid)
     assert jax.process_count() == num_procs, jax.process_count()
 
+    # --- cross-process trace propagation: every host adopts process 0's
+    # trace id over the allgather, so spans/pulses/spools from all hosts
+    # correlate and merged Perfetto timelines share one trace ---
+    trace_ctx = distributed.adopt_shared_trace_context(role="mh_worker")
+
     import numpy as np
 
     from tpu_tfrecord import wire
@@ -177,6 +182,8 @@ def main() -> None:
                 "resume_ok": resume_ok,
                 "shuffle_ok": shuffle_ok,
                 "host_rows_total": len(full),
+                "trace_id": trace_ctx.trace_id,
+                "trace_parent": trace_ctx.parent_span_id,
             }
         )
     )

@@ -181,6 +181,77 @@ def test_scheme_errors_cleanly(monkeypatch):
         tfio.read("noproto42://bucket/x", schema=SCHEMA)
 
 
+class TestIndependentReadHandles:
+    """The explicit handle-capability flag (ISSUE 7 satellite, ROADMAP #3 /
+    ADVICE #1): PrefetchReader may only run concurrent range fetches on a
+    backend KNOWN to hand out one independent file object per open().
+    Unknown backends default to the safe serialized path — slower, never
+    silently corrupt — where the old protocol sniff defaulted them to the
+    corrupting parallel path."""
+
+    def _proto(self, proto):
+        class _FS:
+            protocol = proto
+
+        return _FS()
+
+    def test_known_object_stores_are_independent(self):
+        for proto in ("s3", "gs", "gcs", "abfs", "http", "hdfs", "file"):
+            assert tfs.independent_read_handles(self._proto(proto)), proto
+
+    def test_memory_and_unknown_schemes_serialize(self):
+        assert not tfs.independent_read_handles(self._proto("memory"))
+        assert not tfs.independent_read_handles(self._proto("someproto42"))
+        assert not tfs.independent_read_handles(object())  # no declaration
+        assert not tfs.independent_read_handles(None)
+
+    def test_multi_protocol_requires_all_known(self):
+        assert tfs.independent_read_handles(self._proto(("gs", "gcs")))
+        assert not tfs.independent_read_handles(self._proto(("gs", "weird")))
+
+    def test_capability_flag_beats_protocol(self):
+        # a wrapper/backend that KNOWS its handle semantics declares them,
+        # overriding whatever the protocol classification would say
+        class _IndependentUnknown:
+            protocol = "someproto42"
+            independent_read_handles = True
+
+        class _SharedS3:
+            protocol = "s3"
+            independent_read_handles = False
+
+        assert tfs.independent_read_handles(_IndependentUnknown())
+        assert not tfs.independent_read_handles(_SharedS3())
+
+    def test_walks_wrapper_chain(self):
+        # FsspecFS/ChaosFS-style wrappers: the first declaration found
+        # walking ._fs wins
+        class _Inner:
+            protocol = "s3"
+
+        class _Wrapper:
+            def __init__(self, inner):
+                self._fs = inner
+
+        assert tfs.independent_read_handles(_Wrapper(_Inner()))
+        assert not tfs.independent_read_handles(_Wrapper(_Wrapper(object())))
+
+        class _OptOutWrapper:
+            # e.g. a caching wrapper that funnels every handle through one
+            # shared buffer: declares, so the inner s3 is never consulted
+            independent_read_handles = False
+
+            def __init__(self, inner):
+                self._fs = inner
+
+        assert not tfs.independent_read_handles(_OptOutWrapper(_Inner()))
+
+    def test_fsspec_memory_serializes_end_to_end(self, mem_url):
+        # the real memory:// filesystem classifies as shared-handle
+        mfs = tfs.filesystem_for(mem_url)
+        assert not tfs.independent_read_handles(mfs)
+
+
 class TestRemotePrefetch:
     """Block-pipelined remote readahead (VERDICT r4 item 3): N concurrent
     range fetches hide per-block link latency; a serial read pays it."""
@@ -240,9 +311,11 @@ class TestRemotePrefetch:
                 return self._closed
 
         class _SlowFS:
-            # independent handles: opt out of the memory:// serialization
-            # (fs._shares_read_handles stops at the first declared protocol)
+            # each open() returns its own _SlowFile (own cursor): declare
+            # the capability explicitly — "slowlink" is an unknown scheme,
+            # which fs.independent_read_handles would otherwise serialize
             protocol = "slowlink"
+            independent_read_handles = True
 
             def __init__(self, fs):
                 self._fs = fs

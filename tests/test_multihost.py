@@ -109,6 +109,11 @@ def test_multi_process_schema_merge_and_global_batch(sandbox, tmp_path, num_proc
     # per-host windowed row shuffle: mid-window resume exact, coverage
     # identical to the unshuffled stream, order actually permuted
     assert all(o["shuffle_ok"] for o in outs)
+    # shared trace id: every host adopted process 0's over the allgather;
+    # process 0 is the root (no parent), the rest point at its root span
+    assert len({o["trace_id"] for o in outs}) == 1
+    assert first["trace_parent"] is None
+    assert all(o["trace_parent"] for o in outs[1:])
     assert sum(o["host_rows_total"] for o in outs) == 8 * n_shards
     # coordinated write: marker appears only after the global barrier, and
     # the combined dataset contains every host's rows

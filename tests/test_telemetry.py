@@ -325,7 +325,15 @@ class TestChromeTrace:
         # round-trips through JSON (Perfetto loads a file, not a dict)
         doc = json.loads(json.dumps(doc))
         assert doc["displayTimeUnit"] == "ms"
-        events = doc["traceEvents"]
+        # metadata records lead: a process_name track label (fleet merges
+        # rely on it) and a thread_name for each live recorded thread
+        meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert meta[0]["name"] == "process_name"
+        assert any(
+            e["name"] == "thread_name" and e["args"]["name"] == "MainThread"
+            for e in meta
+        )
+        events = [e for e in doc["traceEvents"] if e["ph"] != "M"]
         assert len(events) == 2
         for ev in events:
             for key in ("name", "cat", "ph", "ts", "pid", "tid"):

@@ -17,6 +17,8 @@ Usage::
     tools/tfrecord_doctor.py cache --evict-stale CACHE_DIR
     tools/tfrecord_doctor.py report DATA_DIR              # bottleneck doctor
     tools/tfrecord_doctor.py tune DATA_DIR                # offline autotune
+    tools/tfrecord_doctor.py fleet SPOOL_DIR              # cluster doctor
+    tools/tfrecord_doctor.py merge-trace OUT F1 F2 ...    # fuse Perfetto traces
 
 The ``report`` subcommand is the bottleneck doctor: it runs N batches of
 the real pipeline with the flight recorder on (tpu_tfrecord.telemetry)
@@ -37,6 +39,24 @@ controller decision (the convergence trajectory) and a final
 ``{"event": "tune", ...}`` line with the converged knob set and the
 throughput it reached — the values to bake into a fixed-knob production
 config for this box/dataset pair.
+
+The ``fleet`` subcommand is the cluster doctor (tpu_tfrecord.fleet): it
+aggregates a telemetry spool directory — one JSONL file per process, each
+process of a job spooling with ``telemetry_spool_dir`` pointed at the
+same dir — and prints one ``{"event": "proc", ...}`` line per process
+(host/pid/role, liveness + heartbeat age, decode throughput, stage
+p50/p99, per-process bound-ness verdict) and a final
+``{"event": "fleet", ...}`` line with the cluster-level counters (exact
+sums), cluster latency quantiles (exact histogram-bucket merges), the
+dead-process list, and the cluster verdict — "which worker is slow, which
+worker is DEAD, and is the fleet producer- or consumer-bound" answered
+from files alone, no live processes required.
+
+``merge-trace OUT F1 F2 ...`` fuses K per-process Chrome trace files
+(``save_chrome_trace`` output) into one Perfetto timeline with a labeled
+track per process (telemetry.merge_chrome_traces) — pid collisions
+across hosts are remapped, every process renders under its
+``role@host:pid`` label.
 
 The ``cache`` subcommand audits a columnar epoch cache directory
 (tpu_tfrecord.cache): one ``{"event": "cache_entry", ...}`` line per entry
@@ -451,6 +471,174 @@ def tune_main(argv: List[str]) -> int:
     return 0
 
 
+def fleet_main(argv: List[str]) -> int:
+    """The ``fleet`` subcommand: aggregate a telemetry spool dir and print
+    the cluster picture. Exit 0 = report produced (dead workers are a
+    finding, not a failure); 2 = unreadable spool dir or no spool files."""
+    ap = argparse.ArgumentParser(
+        prog="tfrecord_doctor fleet",
+        description="Cluster doctor: merge per-process telemetry spools "
+        "and explain the fleet",
+    )
+    ap.add_argument("spool_dir", help="telemetry spool directory")
+    ap.add_argument(
+        "--stale-after", type=float, default=None, metavar="SECONDS",
+        help="heartbeat age beyond which a process is dead "
+        "(default: 2x each process's own snapshot interval)",
+    )
+    ap.add_argument(
+        "--trace-id", default=None, metavar="ID",
+        help="only merge spool files from this run (a reused spool dir "
+        "keeps previous runs' files; the fleet line's trace_ids list "
+        "shows what is mixed in)",
+    )
+    args = ap.parse_args(argv)
+
+    from tpu_tfrecord import fleet, telemetry
+
+    def emit(obj: Dict) -> None:
+        sys.stdout.write(json.dumps(obj, sort_keys=True) + "\n")
+
+    try:
+        agg = fleet.TelemetryAggregator(
+            args.spool_dir, stale_after_s=args.stale_after,
+            trace_id=args.trace_id,
+        )
+        snap = agg.aggregate()
+    except Exception as e:
+        # unreadable dir, or spool contents the aggregator cannot merge —
+        # either way the documented contract is an error line + exit 2,
+        # never a traceback
+        emit({"event": "error", "path": args.spool_dir, "error": str(e)})
+        return 2
+    if not snap.processes:
+        # distinguish an empty/missing spool dir from a --trace-id filter
+        # that matched nothing: the latter sends the operator to the
+        # filter (typo'd or stale id), not to a directory that is in fact
+        # full of spool files from other runs
+        err: Dict = {"event": "error", "path": args.spool_dir}
+        present = (
+            [s.trace_id for s in fleet.TelemetryAggregator(
+                args.spool_dir, clock=agg._clock).processes()]
+            if args.trace_id is not None else []
+        )
+        if present:
+            err["error"] = (
+                f"no spool files match trace_id {args.trace_id!r}"
+            )
+            err["spool_files"] = len(present)
+            err["trace_ids_present"] = sorted(
+                {t for t in present if t}
+            )
+        else:
+            err["error"] = "no spool files found"
+        emit(err)
+        return 2
+    now = agg._clock()
+    dead_ids = {id(p) for p in snap.dead}
+    for p in snap.processes:
+        decode = p.stages.get("decode") or p.stages.get("cache.serve")
+        # throughput over the process's WALL observation window (spool
+        # start -> last heartbeat, both on the writer's clock): stage
+        # seconds are cumulative busy time summed across decode threads,
+        # and dividing by those would understate a parallel worker by
+        # its thread count
+        wall = p.heartbeat - p.created if p.created else 0.0
+        line: Dict = {
+            "event": "proc",
+            "host": p.host,
+            "pid": p.pid,
+            "role": p.role,
+            "alive": id(p) not in dead_ids,
+            # a clean-shutdown final snapshot: finished, never flagged dead
+            **({"finished": True} if p.final else {}),
+            "heartbeat_age_s": round(p.heartbeat_age(now), 3),
+            "seq": p.seq,
+            "records_per_sec": (
+                round(decode[0] / wall, 1)
+                if decode and wall > 0 else None
+            ),
+            "verdict": telemetry.boundness_verdict(
+                p.gauges.get(telemetry.OCCUPANCY_GAUGE)
+            ),
+        }
+        try:
+            q = fleet.quantiles_ms_from_states(p.hists)
+        except Exception:
+            q = None  # one process's corrupt hist state: drop its
+            # quantiles, keep its line (and the rest of the report)
+        if q:
+            line["quantiles"] = q
+        if p.skipped_lines:
+            line["skipped_lines"] = p.skipped_lines
+        emit(line)
+    emit(
+        {
+            "event": "fleet",
+            "path": args.spool_dir,
+            "processes": len(snap.processes),
+            "alive": len(snap.alive),
+            "finished": sum(1 for p in snap.processes if p.final),
+            "dead": [
+                {"host": p.host, "pid": p.pid, "role": p.role,
+                 "heartbeat_age_s": round(p.heartbeat_age(now), 3)}
+                for p in snap.dead
+            ],
+            "counters": snap.counters,
+            "stages": {
+                name: {"records": t[0], "bytes": t[1], "seconds": round(t[3], 6)}
+                for name, t in sorted(snap.stages.items())
+            },
+            "quantiles": telemetry.quantiles_ms(snap.quantiles()),
+            "occupancy": (
+                round(snap.occupancy, 4) if snap.occupancy is not None else None
+            ),
+            "verdict": snap.verdict,
+            "trace_ids": sorted(
+                {p.trace_id for p in snap.processes if p.trace_id}
+            ),
+        }
+    )
+    return 0
+
+
+def merge_trace_main(argv: List[str]) -> int:
+    """The ``merge-trace`` subcommand: fuse per-process Chrome traces into
+    one Perfetto timeline. Exit 0 = merged; 2 = unreadable/malformed input."""
+    ap = argparse.ArgumentParser(
+        prog="tfrecord_doctor merge-trace",
+        description="Fuse per-process Chrome trace files into one "
+        "pid-labeled Perfetto timeline",
+    )
+    ap.add_argument("out", help="merged trace output path")
+    ap.add_argument("traces", nargs="+", help="per-process trace JSON files")
+    args = ap.parse_args(argv)
+
+    from tpu_tfrecord import telemetry
+
+    def emit(obj: Dict) -> None:
+        sys.stdout.write(json.dumps(obj, sort_keys=True) + "\n")
+
+    try:
+        merged = telemetry.merge_chrome_traces(args.out, args.traces)
+    except (OSError, ValueError) as e:
+        emit({"event": "error", "path": args.out, "error": str(e)})
+        return 2
+    pids = {
+        e.get("pid") for e in merged["traceEvents"] if e.get("pid") is not None
+    }
+    emit(
+        {
+            "event": "merged_trace",
+            "path": args.out,
+            "inputs": len(args.traces),
+            "pids": len(pids),
+            "events": len(merged["traceEvents"]),
+        }
+    )
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -460,6 +648,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         return report_main(argv[1:])
     if argv and argv[0] == "tune":
         return tune_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        return fleet_main(argv[1:])
+    if argv and argv[0] == "merge-trace":
+        return merge_trace_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="tfrecord_doctor", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,

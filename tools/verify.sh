@@ -238,6 +238,77 @@ print("autotune smoke OK:", json.dumps({
 }))
 PY
 
+echo "== fleet smoke (3 spooling readers -> exact aggregation + fleet doctor + merged trace) =="
+# Three short-lived reader subprocesses spool into one directory while a
+# shared trace context propagates via TFR_TRACE_CONTEXT: the aggregator's
+# merged read decode count must equal the SUM of the per-process counts
+# exactly, `tfrecord_doctor fleet` must exit 0 with a verdict, and the
+# merged Chrome trace must parse with >= 3 distinct pid tracks — so the
+# cluster flight recorder can't rot.
+env JAX_PLATFORMS=cpu python - <<'PY' || exit 1
+import json, os, subprocess, sys, tempfile
+
+import tpu_tfrecord.io as tfio
+from tpu_tfrecord import fleet, telemetry
+from tpu_tfrecord.schema import LongType, StringType, StructField, StructType
+
+schema = StructType([StructField("id", LongType(), nullable=False),
+                     StructField("s", StringType())])
+root = tempfile.mkdtemp(prefix="tfr_fleet_smoke_")
+out = os.path.join(root, "ds")
+for s in range(3):
+    tfio.write([[i, f"s{i}"] for i in range(s * 40, (s + 1) * 40)],
+               schema, out, mode="append" if s else "overwrite")
+
+spool = os.path.join(root, "spool")
+ctx = telemetry.TraceContext.new(role="verify")
+env = {**os.environ, "JAX_PLATFORMS": "cpu", **ctx.to_env()}
+traces = [os.path.join(root, f"trace-{i}.json") for i in range(3)]
+procs = [subprocess.Popen(
+    [sys.executable, "tests/fleet_worker.py", out, spool,
+     "--role", f"reader{i}", "--epochs", "2", "--interval", "0.1",
+     "--trace-out", traces[i]],
+    stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+) for i in range(3)]
+outs = []
+for p in procs:
+    o, e = p.communicate(timeout=240)
+    assert p.returncode == 0, (p.returncode, o, e)
+    outs.append(json.loads(o.splitlines()[-1]))
+assert {o["trace_id"] for o in outs} == {ctx.trace_id}, outs
+
+snap = fleet.TelemetryAggregator(spool).aggregate()
+per_proc = sum(o["decode_records"] for o in outs)
+assert len(snap.processes) == 3, [p.path for p in snap.processes]
+assert snap.stages["decode"][0] == per_proc, \
+    (snap.stages["decode"], per_proc)
+
+doc = subprocess.run([sys.executable, "tools/tfrecord_doctor.py", "fleet",
+                      spool, "--stale-after", "3600"],
+                     capture_output=True, text=True)
+assert doc.returncode == 0, (doc.returncode, doc.stdout, doc.stderr)
+lines = [json.loads(l) for l in doc.stdout.splitlines() if l.strip()]
+fleet_line = [l for l in lines if l.get("event") == "fleet"][0]
+assert fleet_line.get("verdict"), fleet_line
+
+merged_path = os.path.join(root, "merged.json")
+mt = subprocess.run([sys.executable, "tools/tfrecord_doctor.py",
+                     "merge-trace", merged_path] + traces,
+                    capture_output=True, text=True)
+assert mt.returncode == 0, (mt.returncode, mt.stdout, mt.stderr)
+doc = json.load(open(merged_path))
+pids = {e["pid"] for e in doc["traceEvents"]}
+assert len(pids) >= 3, pids
+named = {e["pid"] for e in doc["traceEvents"]
+         if e.get("ph") == "M" and e["name"] == "process_name"}
+assert pids <= named, (pids, named)
+print("fleet smoke OK:", json.dumps({
+    "decode_sum": per_proc,
+    "doctor_verdict": fleet_line["verdict"],
+    "merged_pid_tracks": len(pids),
+}))
+PY
+
 echo "== tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log

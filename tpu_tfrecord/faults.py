@@ -290,7 +290,9 @@ class ChaosFS:
     the plan; everything else passes through untouched."""
 
     def __init__(self, inner, plan: FaultPlan):
-        self._fs = inner  # name kept so fs._shares_read_handles can walk it
+        # name kept so fs.independent_read_handles can walk the wrapper
+        # chain to the wrapped backend's capability flag/protocol
+        self._fs = inner
         self._plan = plan
 
     def open(self, path: str, mode: str):

@@ -353,23 +353,63 @@ def _remote_prefetch_params() -> tuple:
     return block, depth
 
 
-def _shares_read_handles(fs) -> bool:
-    """True for backends whose open() hands out one shared file object
-    (fsspec memory://) — prefetch fetches must serialize there. Walks the
-    ``_fs`` wrapper chain (FsspecFS, test shims) to the first object that
-    declares a ``protocol``; a wrapper that makes handles independent can
-    opt out by declaring its own non-memory protocol."""
+#: fsspec protocols whose ``open()`` is known to hand out an INDEPENDENT
+#: file object per call (its own cursor), so PrefetchReader may run its
+#: block fetches concurrently. Everything else — including ``memory://``
+#: (one shared file object per path) and any scheme not listed here —
+#: serializes: on an unknown backend, concurrent seek+read on a possibly
+#: shared handle would silently return corrupted blocks, while needless
+#: serialization merely costs parallelism (ADVICE r5 #1 / ROADMAP #3 —
+#: the old protocol SNIFF defaulted unknown schemes to the corrupting
+#: parallel path).
+_INDEPENDENT_HANDLE_PROTOCOLS = frozenset(
+    {
+        "file", "local",
+        "s3", "s3a",
+        "gs", "gcs",
+        "az", "abfs", "abfss", "adl",
+        "http", "https",
+        "hdfs", "webhdfs",
+        "oss",
+    }
+)
+# NOT listed (deliberately): ftp funnels every file object through ONE
+# shared ftplib control connection, and sftp/ssh multiplex one paramiko
+# channel — concurrent range fetches there interleave protocol traffic on
+# a shared session, which is exactly the corruption mode this flag
+# exists to rule out. They serialize like any unknown scheme.
+
+
+def independent_read_handles(fs) -> bool:
+    """Explicit capability flag: may PrefetchReader fetch blocks of one
+    object CONCURRENTLY through ``fs.open()``?
+
+    Resolution order, walking the ``_fs`` wrapper chain (FsspecFS wraps
+    the fsspec filesystem; ChaosFS and test shims wrap either):
+
+    1. an ``independent_read_handles`` attribute anywhere on the chain —
+       the capability declaration; wrappers that change handle semantics
+       (or backends fsspec cannot classify) set it explicitly;
+    2. a declared fsspec ``protocol``, classified against the known
+       independent-handle allowlist above;
+    3. neither found, or an unknown protocol: **False** — serialize.
+       Unknown backends default to the SAFE path: slower, never corrupt.
+    """
     obj = fs
-    for _ in range(4):
+    for _ in range(8):
         if obj is None:
             return False
-        proto = obj.__dict__.get("protocol", None) or getattr(
+        cap = getattr(obj, "independent_read_handles", None)
+        if cap is not None and not callable(cap):
+            return bool(cap)
+        proto = getattr(obj, "__dict__", {}).get("protocol", None) or getattr(
             type(obj), "protocol", None
         )
         if proto is not None:
-            if isinstance(proto, (list, tuple)):
-                return "memory" in proto
-            return "memory" in str(proto)
+            protos = (
+                tuple(proto) if isinstance(proto, (list, tuple)) else (str(proto),)
+            )
+            return all(p in _INDEPENDENT_HANDLE_PROTOCOLS for p in protos)
         obj = getattr(obj, "_fs", None)
     return False
 
@@ -389,7 +429,7 @@ def open_for_read(fs, path: str) -> BinaryIO:
     if size is not None and size >= 2 * block:
         return PrefetchReader(
             fs, path, size, block, depth,
-            serialize_fetches=_shares_read_handles(fs),
+            serialize_fetches=not independent_read_handles(fs),
         )
     return fs.open(path, "rb")
 

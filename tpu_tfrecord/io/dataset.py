@@ -210,6 +210,15 @@ class TFRecordDataset:
             telemetry.enable()
         if self.options.telemetry_port is not None:
             telemetry.ensure_exporter(self.options.telemetry_port)
+        if self.options.telemetry_role is not None:
+            # process identity for pulse lines, spool snapshots, and
+            # merged-trace track labels (tpu_tfrecord.fleet); like the
+            # recorder, the context is process-global
+            telemetry.adopt(
+                telemetry.current_context().with_role(
+                    self.options.telemetry_role
+                )
+            )
         self.batch_size = batch_size
         self.drop_remainder = drop_remainder
         self.num_epochs = num_epochs
@@ -1484,6 +1493,41 @@ class CheckpointableIterator:
             self._pulse_finalizer = weakref.finalize(
                 self, Pulse.stop, self._pulse, False
             )
+        # Cluster telemetry spool (tpu_tfrecord.fleet): periodic atomic
+        # snapshots of this process's registry + heartbeat into one file
+        # per process under spool_dir, for the fleet aggregator/doctor.
+        # Refcounted process singleton (snapshots are process-global);
+        # spool_dir unset = this branch is the only new work.
+        # abspath ONCE: acquire and the (possibly much later) release must
+        # agree on the registry key even if the process chdirs in between.
+        # Scheme'd dirs ("gs://...") pass through untouched — abspath would
+        # mangle them into a local path BEFORE TelemetrySpool's loud
+        # rejection could see the scheme, silently spooling into a private
+        # local dir on every host.
+        spool_dir = dataset.options.telemetry_spool_dir
+        if spool_dir is not None:
+            from tpu_tfrecord import fs as _fs
+
+            if not _fs.has_scheme(spool_dir):
+                spool_dir = os.path.abspath(spool_dir)
+        self._spool_dir = spool_dir
+        if self._spool_dir is not None:
+            from tpu_tfrecord import fleet
+
+            fleet.acquire_spool(
+                self._spool_dir,
+                # None keeps the process's adopted trace-context role (the
+                # documented telemetry_role default) instead of clobbering
+                # it back to a fixed label
+                role=dataset.options.telemetry_role,
+                interval_s=dataset.options.spool_interval_s,
+            )
+            # the finalizer releases the refcount for abandoned iterators;
+            # _stop_pulse fires it explicitly on clean shutdown (finalize
+            # callables are once-only, so the pair can't double-release)
+            self._spool_finalizer = weakref.finalize(
+                self, fleet.release_spool, self._spool_dir
+            )
         # If the iterator is abandoned without close() (no with-block, early
         # break, GC after an error), the finalizer trips the stop event so
         # producer/dispatcher/worker threads exit and shard buffers free.
@@ -1547,12 +1591,18 @@ class CheckpointableIterator:
         return batch
 
     def _stop_pulse(self) -> None:
-        """Stop the telemetry pulse at end of iteration (exhausted, failed,
-        or closed); the final tick covers the tail interval."""
+        """Stop the telemetry pulse and release the fleet spool at end of
+        iteration (exhausted, failed, or closed); the final tick/snapshot
+        covers the tail interval."""
         pulse, self._pulse = self._pulse, None
         if pulse is not None:
             try:
                 pulse.stop()
+            except Exception:
+                pass
+        if self._spool_dir is not None:
+            try:
+                self._spool_finalizer()  # once-only: safe vs the GC path
             except Exception:
                 pass
 

@@ -101,9 +101,12 @@ class Metrics:
         per-stage error counters ``<stage>.errors`` (bumped by ``timed``
         when an exception propagates through it), the backpressure
         counters ``read.backpressure_waits``/``write.backpressure_waits``,
-        and the autotune decision counter ``autotune.adjustments`` (each
+        the autotune decision counter ``autotune.adjustments`` (each
         controller knob move — the current knob VALUES live in the
-        ``autotune.<knob>`` gauges).
+        ``autotune.<knob>`` gauges), and the cluster-spool counters
+        (``fleet.spool_writes`` = snapshots landed in the telemetry spool,
+        ``fleet.spool_errors`` = snapshot attempts that failed — spooling
+        is telemetry, it never raises into the pipeline).
 
         INSTANTANEOUS values (queue depths, occupancies, in-flight worker
         counts) belong in ``gauge()``, not here — a counter only goes up.
@@ -167,6 +170,15 @@ class Metrics:
                 or name.startswith(prefix + ".")
             }
 
+    def hist_states(self) -> Dict[str, dict]:
+        """One-lock copy of every stage histogram's mergeable state
+        (telemetry.Histogram.state — sparse bucket counts). The spool
+        writer (tpu_tfrecord.fleet) ships these across processes; fixed
+        shared bucket layout means the aggregator's merge is EXACT, so
+        cluster p99s are real quantiles, not averages of quantiles."""
+        with self._lock:
+            return {name: hist.state() for name, hist in self._hists.items()}
+
     def stage(self, stage: str) -> StageStats:
         with self._lock:
             return self._stages.setdefault(stage, StageStats())
@@ -226,6 +238,10 @@ class Metrics:
             self._stages.clear()
             self._gauges.clear()
             self._hists.clear()
+            # the fleet spool's wall-window epoch (tpu_tfrecord.fleet
+            # stamps it on this registry) describes the totals just
+            # cleared — a restarted registry restarts the window
+            self.__dict__.pop("_spool_epoch", None)
 
 
 # Process-global default registry.

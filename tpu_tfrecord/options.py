@@ -129,6 +129,24 @@ class TFRecordOptions:
       - telemetry_port: serve a Prometheus text endpoint (``/metrics``)
         on 127.0.0.1:PORT via a stdlib HTTP daemon thread (0 = an
         ephemeral port). None (default) = no endpoint.
+      - telemetry_spool_dir: cluster telemetry spool (tpu_tfrecord.fleet).
+        When set, this process periodically snapshots its counters,
+        gauges, and histogram buckets (plus a heartbeat) into one
+        atomically-rewritten JSONL file per process under this directory;
+        a TelemetryAggregator / ``tfrecord_doctor fleet`` merges every
+        process's spool into cluster-level counters, exact cluster
+        quantiles, a dead-process list, and one federated Prometheus
+        page. None (default) = no spool, zero new work on the hot path.
+        Point every process of one job (decode workers, trainers, the
+        dispatcher) at the SAME directory.
+      - spool_interval_s: snapshot/heartbeat cadence for the spool
+        (default 1.0s when ``telemetry_spool_dir`` is set). The
+        aggregator's default staleness bar is 2x this interval.
+      - telemetry_role: role label this process stamps on its pulse
+        lines, spool snapshots, and merged-trace track names (e.g.
+        ``"reader"``, ``"decode_worker"``, ``"trainer"``). Default: the
+        process's current trace-context role (``"main"`` unless a parent
+        propagated one).
       - autotune: closed-loop knob tuning (tpu_tfrecord.autotune).
         ``"off"`` (default) keeps every knob static; ``"on"`` runs a
         controller at pulse boundaries that resizes the decode worker
@@ -166,6 +184,9 @@ class TFRecordOptions:
     trace: str = "off"
     pulse_interval_s: Optional[float] = None
     telemetry_port: Optional[int] = None
+    telemetry_spool_dir: Optional[str] = None
+    spool_interval_s: Optional[float] = None
+    telemetry_role: Optional[str] = None
     autotune: str = "off"
     autotune_interval_s: Optional[float] = None
 
@@ -212,6 +233,12 @@ class TFRecordOptions:
         "pulseIntervalS",
         "telemetry_port",
         "telemetryPort",
+        "telemetry_spool_dir",
+        "telemetrySpoolDir",
+        "spool_interval_s",
+        "spoolIntervalS",
+        "telemetry_role",
+        "telemetryRole",
         "autotune",
         "autotune_interval_s",
         "autotuneIntervalS",
@@ -351,6 +378,25 @@ class TFRecordOptions:
                 raise ValueError(
                     "telemetry_port must be in [0, 65535] (0 = ephemeral)"
                 )
+        telemetry_spool_dir = merged.pop(
+            "telemetry_spool_dir", merged.pop("telemetrySpoolDir", None)
+        )
+        if telemetry_spool_dir is not None:
+            telemetry_spool_dir = os.fspath(telemetry_spool_dir)
+        spool_interval_s = merged.pop(
+            "spool_interval_s", merged.pop("spoolIntervalS", None)
+        )
+        if spool_interval_s is not None:
+            spool_interval_s = float(spool_interval_s)
+            if spool_interval_s <= 0:
+                raise ValueError("spool_interval_s must be > 0 (or None)")
+        telemetry_role = merged.pop(
+            "telemetry_role", merged.pop("telemetryRole", None)
+        )
+        if telemetry_role is not None:
+            telemetry_role = str(telemetry_role)
+            if not telemetry_role:
+                raise ValueError("telemetry_role must be non-empty (or None)")
         autotune = str(merged.pop("autotune", "off") or "off").strip().lower()
         if autotune not in TFRecordOptions.AUTOTUNE_MODES:
             raise ValueError(
@@ -403,6 +449,9 @@ class TFRecordOptions:
             trace=trace,
             pulse_interval_s=pulse_interval_s,
             telemetry_port=telemetry_port,
+            telemetry_spool_dir=telemetry_spool_dir,
+            spool_interval_s=spool_interval_s,
+            telemetry_role=telemetry_role,
             autotune=autotune,
             autotune_interval_s=autotune_interval_s,
         )

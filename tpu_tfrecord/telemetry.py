@@ -61,18 +61,25 @@ import it without cycles.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import math
 import os
+import socket
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Tuple
+import uuid
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 __all__ = [
     "Histogram",
     "SpanRecorder",
     "Pulse",
     "RECORDER",
+    "TraceContext",
+    "current_context",
+    "adopt",
+    "adopt_from_env",
     "span",
     "instant",
     "enable",
@@ -81,8 +88,11 @@ __all__ = [
     "verdict_from_metrics",
     "OccupancyEma",
     "quantiles_ms",
+    "merge_chrome_traces",
+    "atomic_write_bytes",
     "prometheus_text",
     "ensure_exporter",
+    "serve_text_endpoint",
     "exporter_address",
     "shutdown_exporter",
 ]
@@ -166,6 +176,225 @@ class Histogram:
             "mean_s": self.total / self.count,
         }
 
+    # -- cross-process export/merge ------------------------------------------
+    #
+    # The bucket layout is FIXED (same floor, growth, count in every
+    # process), so per-process histograms merge exactly: bucket counts
+    # add, min/max fold — the merged histogram is bucket-identical to one
+    # histogram fed every process's observations (pinned by a property
+    # test in tests/test_fleet.py). This is what makes cluster-level
+    # quantiles from per-process spool snapshots honest rather than an
+    # average-of-quantiles approximation.
+
+    def state(self) -> Dict[str, Any]:
+        """JSON-serializable snapshot: sparse bucket counts + count/total/
+        min/max. The layout params ride along so a merge across versions
+        with a different bucket geometry fails loudly instead of blending
+        incompatible buckets."""
+        return {
+            "buckets": {
+                str(i): c for i, c in enumerate(self.counts) if c
+            },
+            "count": self.count,
+            "total": self.total,
+            "min": self.min if self.count else None,
+            "max": self.max,
+            "layout": [self._MIN, self._LOG2_GROWTH, self._NBUCKETS],
+        }
+
+    def merge_state(self, state: Dict[str, Any]) -> None:
+        """Fold one ``state()`` snapshot in (exact: fixed shared buckets)."""
+        if not isinstance(state, dict):
+            raise TypeError(
+                f"histogram state must be a mapping, got {type(state).__name__}"
+            )
+        layout = state.get("layout")
+        if layout is not None and list(layout) != [
+            self._MIN, self._LOG2_GROWTH, self._NBUCKETS,
+        ]:
+            raise ValueError(
+                f"histogram bucket layout mismatch: {layout} vs "
+                f"{[self._MIN, self._LOG2_GROWTH, self._NBUCKETS]}"
+            )
+        buckets = state.get("buckets") or {}
+        if not isinstance(buckets, dict):
+            raise TypeError(
+                f"histogram buckets must be a mapping, got {type(buckets).__name__}"
+            )
+        for idx, c in buckets.items():
+            i = int(idx)
+            if not 0 <= i < self._NBUCKETS:
+                # a negative index would silently wrap into the tail bucket
+                raise ValueError(f"histogram bucket index out of range: {i}")
+            self.counts[i] += int(c)
+        self.count += int(state.get("count", 0))
+        self.total += float(state.get("total", 0.0))
+        smin = state.get("min")
+        if smin is not None and smin < self.min:
+            self.min = smin
+        smax = state.get("max")
+        if smax is not None and smax > self.max:
+            self.max = smax
+
+    @classmethod
+    def from_states(cls, states: Iterable[Dict[str, Any]]) -> "Histogram":
+        hist = cls()
+        for st in states:
+            hist.merge_state(st)
+        return hist
+
+
+# ---------------------------------------------------------------------------
+# Cross-process trace context
+# ---------------------------------------------------------------------------
+
+#: Environment variable carrying a serialized TraceContext from a parent
+#: process to its children (doctor subprocesses, multihost workers, future
+#: data-service workers). ``adopt_from_env`` reads it; ``TraceContext.to_env``
+#: produces the value to put in a child's environment.
+TRACE_CONTEXT_ENV = "TFR_TRACE_CONTEXT"
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """Identity of one process's slice of a distributed trace.
+
+    ``trace_id`` is shared by every process participating in one logical
+    run (a multihost job, a dispatcher + its decode workers); ``span_id``
+    is this process's own root id, and ``parent_span_id`` names the root
+    of the process that spawned/coordinated it (None at the root). role/
+    host/pid identify the process for humans and for the spool aggregator
+    — merged Perfetto timelines label tracks ``role@host:pid``.
+
+    Plain JSON-serializable value: ``to_json``/``from_json`` round-trip
+    it; ``to_env``/``adopt_from_env`` ship it across a process spawn via
+    the ``TFR_TRACE_CONTEXT`` environment variable, the child minting its
+    own span id and stamping its own host/pid (ids propagate, identities
+    never do)."""
+
+    trace_id: str
+    span_id: str
+    parent_span_id: Optional[str] = None
+    role: str = "main"
+    host: str = ""
+    pid: int = 0
+
+    @staticmethod
+    def new(role: str = "main") -> "TraceContext":
+        """A fresh root context for this process."""
+        return TraceContext(
+            trace_id=_new_id(),
+            span_id=_new_id(),
+            parent_span_id=None,
+            role=role,
+            host=socket.gethostname(),
+            pid=os.getpid(),
+        )
+
+    def child(self, role: str) -> "TraceContext":
+        """A context for a process THIS one spawns: same trace, new span
+        id, this context's span as the parent. host/pid are left for the
+        child to stamp at adoption (they describe the child, and the
+        parent cannot know them)."""
+        return TraceContext(
+            trace_id=self.trace_id,
+            span_id=_new_id(),
+            parent_span_id=self.span_id,
+            role=role,
+            host="",
+            pid=0,
+        )
+
+    def with_role(self, role: str) -> "TraceContext":
+        return dataclasses.replace(self, role=role)
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(obj: Dict[str, Any]) -> "TraceContext":
+        known = {f.name for f in dataclasses.fields(TraceContext)}
+        return TraceContext(**{k: v for k, v in obj.items() if k in known})
+
+    def to_env(self) -> Dict[str, str]:
+        """{TFR_TRACE_CONTEXT: payload} to merge into a child process's
+        environment — the child's ``adopt_from_env`` joins this trace."""
+        return {TRACE_CONTEXT_ENV: json.dumps(self.to_json(), sort_keys=True)}
+
+    def label(self) -> str:
+        """The human track label merged timelines use: ``role@host:pid``."""
+        return f"{self.role}@{self.host}:{self.pid}"
+
+
+def current_context() -> TraceContext:
+    """The process's trace context — created (and cached on the global
+    recorder) on first use, so pulse lines and spool snapshots always
+    carry host/pid/role even when nobody propagated a context in."""
+    ctx = RECORDER.context
+    if ctx is None:
+        ctx = RECORDER.adopt(TraceContext.new())
+    return ctx
+
+
+def adopt(ctx: TraceContext) -> TraceContext:
+    """Adopt ``ctx`` as this process's identity on the global recorder
+    (host/pid re-stamped to the adopting process — identities never
+    propagate, only ids do)."""
+    return RECORDER.adopt(ctx)
+
+
+def adopt_from_env(
+    role: Optional[str] = None, environ: Optional[Dict[str, str]] = None
+) -> TraceContext:
+    """Join the trace a parent process shipped via ``TFR_TRACE_CONTEXT``:
+    the child keeps the parent's trace id, records the parent's span id as
+    its parent, and mints its own span id / host / pid. Without the env
+    var this is a fresh root context — subprocesses can call it
+    unconditionally."""
+    environ = os.environ if environ is None else environ
+    raw = environ.get(TRACE_CONTEXT_ENV)
+    if raw:
+        try:
+            obj = json.loads(raw)
+            if not isinstance(obj, dict):
+                # valid JSON that is not an object ('null', '[1]', '"x"')
+                # is just as malformed as unparseable bytes
+                raise ValueError(f"not a JSON object: {obj!r}")
+            parent = TraceContext.from_json(obj)
+            ctx = TraceContext(
+                trace_id=parent.trace_id,
+                span_id=_new_id(),
+                parent_span_id=parent.span_id,
+                role=role if role is not None else parent.role,
+            )
+            return RECORDER.adopt(ctx)
+        except (ValueError, TypeError, KeyError, AttributeError):
+            pass  # a malformed payload must not take the pipeline down
+    return RECORDER.adopt(TraceContext.new(role if role is not None else "main"))
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` via tmp-file + atomic rename, so a crash
+    mid-write never leaves a truncated/corrupt artifact behind for a
+    reader (the spool aggregator, Perfetto) to choke on. The tmp name is
+    pid-suffixed: two processes racing on one path each land a complete
+    file, last rename wins."""
+    tmp = f"{path}.tmp-{os.getpid()}-{_new_id()[:8]}"
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
 
 # ---------------------------------------------------------------------------
 # Span tracing
@@ -243,6 +472,21 @@ class SpanRecorder:
         self._ring: List[Optional[tuple]] = [None] * capacity
         self._seq = 0
         self.dropped = 0
+        #: Adopted TraceContext (None until the process identifies itself
+        #: via ``adopt``/``current_context``). Purely metadata: recording
+        #: never reads it, so the hot path is unchanged.
+        self.context: Optional[TraceContext] = None
+
+    def adopt(self, ctx: TraceContext) -> TraceContext:
+        """Adopt ``ctx`` as this recorder's process identity, re-stamping
+        host/pid to the adopting process (a shipped context carries the
+        PARENT's ids plus a role — never another process's identity)."""
+        host = socket.gethostname()
+        pid = os.getpid()
+        if ctx.host != host or ctx.pid != pid:
+            ctx = dataclasses.replace(ctx, host=host, pid=pid)
+        self.context = ctx
+        return ctx
 
     # -- recording -----------------------------------------------------------
 
@@ -299,10 +543,48 @@ class SpanRecorder:
         """The retained records as a Chrome trace-event JSON object
         (https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU
         — the format Perfetto and chrome://tracing load). Durations are
-        complete ("X") events; point events are instants ("i")."""
-        pid = os.getpid()
-        events = []
-        for name, t0_ns, dur_ns, tid, attrs, ph in self.spans():
+        complete ("X") events; point events are instants ("i").
+
+        Leads with process/thread metadata ("M") records — the process
+        track is named from the adopted TraceContext (``role@host:pid``)
+        and live pipeline threads get their Python thread names — so a
+        ``merge_chrome_traces`` fusion of K per-process files renders as K
+        labeled tracks in one Perfetto timeline. The adopted context also
+        rides the top-level ``traceContext`` key (extra top-level keys are
+        legal in the format), which is how the merger correlates files
+        from different hosts that happen to reuse a pid."""
+        ctx = self.context
+        pid = ctx.pid if ctx is not None and ctx.pid else os.getpid()
+        pname = ctx.label() if ctx is not None else f"tfrecord:{pid}"
+        events: List[Dict[str, Any]] = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": pname},
+            }
+        ]
+        thread_names = {t.ident: t.name for t in threading.enumerate()}
+        seen_tids = set()
+        spans = self.spans()
+        for rec in spans:
+            tid = rec[3]
+            if tid in seen_tids:
+                continue
+            seen_tids.add(tid)
+            name = thread_names.get(tid)
+            if name:  # best-effort: exited threads keep their bare ident
+                events.append(
+                    {
+                        "name": "thread_name",
+                        "ph": "M",
+                        "pid": pid,
+                        "tid": tid,
+                        "args": {"name": name},
+                    }
+                )
+        for name, t0_ns, dur_ns, tid, attrs, ph in spans:
             ev: Dict[str, Any] = {
                 "name": name,
                 "cat": "tfrecord",
@@ -318,11 +600,18 @@ class SpanRecorder:
             if attrs:
                 ev["args"] = attrs
             events.append(ev)
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        out: Dict[str, Any] = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if ctx is not None:
+            out["traceContext"] = ctx.to_json()
+        return out
 
     def save_chrome_trace(self, path: str) -> None:
-        with open(path, "w") as fh:
-            json.dump(self.to_chrome_trace(), fh)
+        """Export atomically (tmp + rename): a crash mid-dump must never
+        leave a truncated JSON for Perfetto or the trace merger to choke
+        on."""
+        atomic_write_bytes(
+            path, json.dumps(self.to_chrome_trace()).encode("utf-8")
+        )
 
 
 #: Process-global flight recorder — spans come from dataset iterators,
@@ -365,6 +654,98 @@ def enable() -> SpanRecorder:
 
 def disable() -> None:
     RECORDER.enabled = False
+
+
+def merge_chrome_traces(out_path: str, in_paths: Iterable[str]) -> Dict[str, Any]:
+    """Fuse K per-process Chrome trace files (``save_chrome_trace``
+    output, or any trace-event JSON object) into ONE Perfetto timeline
+    with one labeled track per process, written atomically to
+    ``out_path`` and returned.
+
+    Processes are distinguished by pid, which is only unique per host:
+    two files whose events share a pid but whose ``traceContext`` names a
+    different host/root-span are given a fresh pid so their tracks never
+    interleave. Files missing a ``process_name`` metadata record (traces
+    from older recorders, hand-built files) get one synthesized from
+    their context label or filename — every pid in the merged timeline
+    renders as a named track. Unreadable/malformed inputs raise
+    (ValueError/OSError): a silently dropped process would make the fused
+    timeline lie."""
+    files = []
+    for path in in_paths:
+        with open(path, "rb") as fh:
+            try:
+                obj = json.load(fh)
+            except ValueError as e:
+                raise ValueError(f"{path}: not valid JSON: {e}") from None
+        if not isinstance(obj, dict) or not isinstance(
+            obj.get("traceEvents"), list
+        ):
+            raise ValueError(f"{path}: not a Chrome trace-event JSON object")
+        files.append((path, obj))
+    events: List[Dict[str, Any]] = []
+    contexts: List[Dict[str, Any]] = []
+    owner: Dict[int, tuple] = {}  # output pid -> identity that holds it
+    max_pid = 0
+    for _, obj in files:
+        for ev in obj["traceEvents"]:
+            if isinstance(ev.get("pid"), int):
+                max_pid = max(max_pid, ev["pid"])
+    for idx, (path, obj) in enumerate(files):
+        ctx = obj.get("traceContext")
+        if not isinstance(ctx, dict):
+            ctx = None
+        if ctx is not None:
+            contexts.append(ctx)
+        # identity: same host + same root span = same process (a pid alone
+        # collides across hosts); context-less files are their own identity
+        ident_base = (
+            (ctx.get("host"), ctx.get("pid"), ctx.get("span_id"))
+            if ctx is not None
+            else (os.path.basename(path), idx)
+        )
+        named = {
+            ev.get("pid", 0)
+            for ev in obj["traceEvents"]
+            if ev.get("ph") == "M" and ev.get("name") == "process_name"
+        }
+        remap: Dict[int, int] = {}
+        file_events: List[Dict[str, Any]] = []
+        for ev in obj["traceEvents"]:
+            pid = ev.get("pid", 0)
+            out_pid = remap.get(pid)
+            if out_pid is None:
+                ident = ident_base + (pid,)
+                out_pid = pid
+                if owner.get(out_pid, ident) != ident:
+                    max_pid += 1
+                    out_pid = max_pid
+                owner[out_pid] = ident
+                remap[pid] = out_pid
+                if pid not in named:
+                    label = (
+                        f"{ctx.get('role', 'proc')}@{ctx.get('host', '?')}:{pid}"
+                        if ctx is not None
+                        else os.path.basename(path)
+                    )
+                    events.append(
+                        {
+                            "name": "process_name",
+                            "ph": "M",
+                            "pid": out_pid,
+                            "tid": 0,
+                            "args": {"name": label},
+                        }
+                    )
+            if out_pid != pid:
+                ev = dict(ev, pid=out_pid)
+            file_events.append(ev)
+        events.extend(file_events)
+    merged: Dict[str, Any] = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if contexts:
+        merged["traceContexts"] = contexts
+    atomic_write_bytes(out_path, json.dumps(merged).encode("utf-8"))
+    return merged
 
 
 # ---------------------------------------------------------------------------
@@ -564,10 +945,20 @@ class Pulse:
         self._prev_totals = totals
         gauges = self.metrics.gauges()
         quantiles = quantiles_ms(self.metrics.quantiles())
+        ctx = current_context()
         payload = {
             "event": "pulse",
             "ts": round(time.time(), 3),
             "interval_s": round(dt, 3),
+            # process identity: in a fleet (every process pulsing into one
+            # log stream) a line is unattributable without host/pid/role,
+            # and trace_id correlates the line with the merged timeline
+            "proc": {
+                "host": ctx.host,
+                "pid": ctx.pid,
+                "role": ctx.role,
+                "trace_id": ctx.trace_id,
+            },
             "stages": stages,
             "counters": counters,
             "gauges": {k: round(v, 4) for k, v in sorted(gauges.items())},
@@ -603,6 +994,47 @@ def _log_pulse(payload: Dict[str, Any]) -> None:
 # ---------------------------------------------------------------------------
 
 
+def escape_label_value(v: Any) -> str:
+    """Prometheus label-value escaping: a value containing a quote,
+    backslash, or newline must not break the exposition format."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def append_family(
+    lines: List[str], fam: str, ftype: str, samples: List[str]
+) -> None:
+    """Append one metric family to an exposition page. The format requires
+    every sample of one family to form a single contiguous block under its
+    # TYPE line — interleaving families per stage makes strict parsers
+    (promtool, OpenMetrics scrapes) reject the page as duplicate families,
+    so both the process page and the fleet's federated page build each
+    family's samples in full before appending through here."""
+    if samples:
+        lines.append(f"# TYPE {fam} {ftype}")
+        lines.extend(samples)
+
+
+def summary_family_lines(
+    fam: str, labeled_quantiles: Iterable[Tuple[str, Dict[str, float]]]
+) -> List[str]:
+    """Samples for a p50/p90/p99 summary family from ``quantiles()``-shaped
+    dicts: per entry, one ``fam{<labels>,quantile="q"} v`` line per
+    quantile plus the ``fam_count{<labels>}`` line."""
+    samples: List[str] = []
+    for label, q in labeled_quantiles:
+        if not q:
+            continue
+        for key, quant in (("p50_s", "0.5"), ("p90_s", "0.9"), ("p99_s", "0.99")):
+            samples.append(f'{fam}{{{label},quantile="{quant}"}} {q[key]:.9f}')
+        samples.append(f'{fam}_count{{{label}}} {q["count"]}')
+    return samples
+
+
 def prometheus_text(metrics=None) -> str:
     """The registry in Prometheus text exposition format: stage totals as
     counters, gauges as gauges, histogram quantiles as a summary-style
@@ -615,13 +1047,7 @@ def prometheus_text(metrics=None) -> str:
     lines: List[str] = []
 
     def family(fam: str, ftype: str, samples: List[str]) -> None:
-        # the exposition format requires every sample of one metric family
-        # to form a single contiguous block under its # TYPE line —
-        # interleaving families per stage makes strict parsers (promtool,
-        # OpenMetrics scrapes) reject the page as duplicate families
-        if samples:
-            lines.append(f"# TYPE {fam} {ftype}")
-            lines.extend(samples)
+        append_family(lines, fam, ftype, samples)
 
     family(
         "tfrecord_stage_records_total",
@@ -657,19 +1083,17 @@ def prometheus_text(metrics=None) -> str:
             for name, value in sorted(metrics.gauges().items())
         ],
     )
-    latency: List[str] = []
-    for name, q in sorted(metrics.quantiles().items()):
-        if not q:
-            continue
-        for key, quant in (("p50_s", "0.5"), ("p90_s", "0.9"), ("p99_s", "0.99")):
-            latency.append(
-                f'tfrecord_latency_seconds{{stage="{name}",'
-                f'quantile="{quant}"}} {q[key]:.9f}'
-            )
-        latency.append(
-            f'tfrecord_latency_seconds_count{{stage="{name}"}} {q["count"]}'
-        )
-    family("tfrecord_latency_seconds", "summary", latency)
+    family(
+        "tfrecord_latency_seconds",
+        "summary",
+        summary_family_lines(
+            "tfrecord_latency_seconds",
+            (
+                (f'stage="{name}"', q)
+                for name, q in sorted(metrics.quantiles().items())
+            ),
+        ),
+    )
     return "\n".join(lines) + "\n"
 
 
@@ -687,19 +1111,43 @@ def ensure_exporter(port: int, metrics=None):
     Stdlib ``http.server`` only — no new dependencies. A port that cannot
     be bound (already taken by another process) logs a warning and returns
     None — telemetry must never take the pipeline down."""
-    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
     if metrics is None:
         from tpu_tfrecord.metrics import METRICS as metrics  # noqa: N813
+
+    reg = metrics
+    return serve_text_endpoint(port, lambda: prometheus_text(reg))
+
+
+def serve_text_endpoint(
+    port: int, render: Callable[[], str], kind: str = "process"
+):
+    """The stdlib-HTTP plumbing under ``ensure_exporter``, parameterized
+    on the page renderer so other registries (the fleet aggregator's
+    federated page, tpu_tfrecord.fleet) serve through the same idempotent
+    per-port server table without duplicating it. Same contract:
+    idempotent per requested port; unbindable port warns and returns
+    None. A port already serving a DIFFERENT page kind (e.g. a
+    ``telemetry_port=0`` process exporter claimed key 0 and a fleet
+    aggregator now asks for 0) also warns and returns None — returning
+    the existing server would let the caller report success while every
+    scrape silently gets the wrong page."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     from tpu_tfrecord.metrics import logger
 
     with _EXPORTERS_LOCK:
         server = _EXPORTERS.get(port)
         if server is not None:
+            served = getattr(server, "_tfr_kind", "process")
+            if served != kind:
+                logger.warning(
+                    "tfrecord.telemetry endpoint for requested port %d "
+                    "already serves the %r page; NOT replacing it with the "
+                    "requested %r page — use a different port",
+                    port, served, kind,
+                )
+                return None  # callers must see the failure, not a server
             return server
-
-        reg = metrics
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server API
@@ -707,7 +1155,7 @@ def ensure_exporter(port: int, metrics=None):
                     self.send_response(404)
                     self.end_headers()
                     return
-                body = prometheus_text(reg).encode()
+                body = render().encode()
                 self.send_response(200)
                 self.send_header(
                     "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
@@ -730,6 +1178,7 @@ def ensure_exporter(port: int, metrics=None):
             )
             return None
         server.daemon_threads = True
+        server._tfr_kind = kind
         threading.Thread(
             target=server.serve_forever, daemon=True, name="tfr-prometheus"
         ).start()

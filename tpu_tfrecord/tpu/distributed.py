@@ -155,6 +155,39 @@ def barrier(name: str) -> None:
         multihost_utils.sync_global_devices(f"tfr_barrier:{name}")
 
 
+def adopt_shared_trace_context(role: str = "worker"):
+    """Give every process in this multihost run ONE trace id (process 0's),
+    adopted onto the process-global span recorder — so per-host Chrome
+    traces, pulse lines, and telemetry spool snapshots all correlate under
+    a single id and ``telemetry.merge_chrome_traces`` fuses them into one
+    labeled timeline. Rides the same allgather as schema inference (each
+    host contributes its local context; everyone deterministically adopts
+    index 0's ids). Non-zero processes record process 0's root span as
+    their parent; every process keeps its own span id/host/pid. Returns
+    the adopted TraceContext. Single-process: just adopts the local
+    context with ``role``."""
+    import dataclasses
+
+    from tpu_tfrecord import telemetry
+
+    local = telemetry.current_context()
+    gathered = allgather_bytes(
+        json.dumps(local.to_json(), sort_keys=True).encode("utf-8")
+    )
+    root = telemetry.TraceContext.from_json(
+        json.loads(gathered[0].decode("utf-8"))
+    )
+    ctx = dataclasses.replace(
+        local,
+        trace_id=root.trace_id,
+        parent_span_id=(
+            None if jax.process_index() == 0 else root.span_id
+        ),
+        role=role,
+    )
+    return telemetry.adopt(ctx)
+
+
 def assert_same_across_hosts(value: bytes, what: str = "value") -> None:
     """Cheap cross-host consistency check (e.g. schema JSON, shard-list
     digest) — catches divergent host state before it corrupts a run."""
