@@ -836,6 +836,67 @@ def _autotune_probe(data_dir, schema, hash_buckets, pack) -> dict:
         it.close()
 
 
+def _service_probe(data_dir, schema, hash_buckets, pack) -> dict:
+    """Disaggregated data service leg (ISSUE 8): K decode-worker
+    SUBPROCESSES (real processes — the consumer's GIL never pays for
+    decode) leased by an in-process dispatcher feed ONE consumer running
+    the SAME device-free host loop as host_side_value, so
+    service_value / host_side_value reads directly as "what does moving
+    decode off-host cost/buy on this box". Device-free by construction:
+    runs in the pre-backend-init block, so a dead TPU tunnel still
+    certifies the service path. Workers inherit K from
+    TFR_BENCH_SERVICE_WORKERS (default 2)."""
+    import subprocess
+    import sys as _sys
+
+    from tpu_tfrecord import service
+    from tpu_tfrecord.metrics import METRICS
+
+    seconds = float(os.environ.get("TFR_BENCH_SERVICE_SECONDS", 4.0))
+    n_workers = int(os.environ.get("TFR_BENCH_SERVICE_WORKERS", 2))
+    d = service.ServiceDispatcher(lease_ttl_s=10.0).start()
+    procs = []
+    try:
+        for _ in range(n_workers):
+            procs.append(subprocess.Popen(
+                [_sys.executable, "-m", "tpu_tfrecord.service", "worker",
+                 "--dispatcher", d.addr],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            ))
+        deadline = time.perf_counter() + 60.0
+        while time.perf_counter() < deadline:
+            if len(d.status()["workers"]) >= n_workers:
+                break
+            time.sleep(0.05)
+        registered = len(d.status()["workers"])
+        before = METRICS.counter("service.fallbacks")
+        value = _host_side_throughput(
+            data_dir, schema, hash_buckets, pack, seconds=seconds,
+            service=d.addr,
+        )
+        fallbacks = METRICS.counter("service.fallbacks") - before
+        return {
+            "service_value": round(value, 1),
+            "service": {
+                "workers": registered,
+                "seconds": seconds,
+                "fallbacks": fallbacks,  # >0 = some shards read locally:
+                # the number above partly measured the fallback, not the
+                # service — disclosed, not hidden
+            },
+        }
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        d.stop()
+
+
 # Self-flagging regression check (ROADMAP #5): the artifact compares its
 # own numbers against the previous round's and flags anything outside a
 # per-field noise band — r5's host_side 1.32M vs r4's 1.51M went
@@ -846,6 +907,7 @@ def _autotune_probe(data_dir, schema, hash_buckets, pack) -> dict:
 _PREV_NOISE_BANDS = {
     "host_side_value": 0.15,
     "seq_host_value": 0.25,
+    "service_value": 0.25,
     "warm_epoch_value": 0.25,
     "cold_value": 0.50,
     "value": 0.35,
@@ -1012,6 +1074,15 @@ def main() -> None:
         # closed-loop autotune convergence vs the fixed-knob reference
         # (~8s, device-free)
         autotune_info = _autotune_probe(data_dir, schema, hash_buckets, pack)
+    service_info = None
+    if os.environ.get("TFR_BENCH_SERVICE", "1") != "0":
+        # disaggregated data service: K worker subprocesses -> 1 consumer,
+        # vs host_side_value (~6s, device-free)
+        service_info = _service_probe(data_dir, schema, hash_buckets, pack)
+        if host_side_value:
+            service_info["service"]["vs_host_side"] = round(
+                service_info["service_value"] / host_side_value, 3
+            )
 
     # Measurement attempts land here the moment they complete, so a guard
     # firing later (e.g. the train phase hanging on a dead tunnel) still
@@ -1044,7 +1115,8 @@ def main() -> None:
                 "error": msg,
             }
             for extra in (cold_info, remote_info, stall_info, warm_info,
-                          telemetry_info, seq_host_info, autotune_info):
+                          telemetry_info, seq_host_info, autotune_info,
+                          service_info):
                 if extra is not None:
                     out.update(extra)
             vs_prev = _vs_previous(out)
@@ -1060,7 +1132,8 @@ def main() -> None:
             "host_side_unit": "examples/sec/host (decode+hash+pack, no device)",
         }
         for extra in (cold_info, remote_info, stall_info, warm_info,
-                      telemetry_info, seq_host_info, autotune_info):
+                      telemetry_info, seq_host_info, autotune_info,
+                      service_info):
             if extra is not None:
                 err.update(extra)
         vs_prev = _vs_previous(err)
@@ -1448,6 +1521,10 @@ def main() -> None:
         # autotune convergence trajectory + final knobs vs fixed-knob
         # (TFR_BENCH_AUTOTUNE=1)
         out.update(autotune_info)
+    if service_info is not None:
+        # disaggregated data service leg: K worker subprocesses -> 1
+        # consumer vs host_side_value (TFR_BENCH_SERVICE=1)
+        out.update(service_info)
     if seq_info is not None:
         # ragged SequenceExample decode->pad->device secondary metric
         out.update(seq_info)

@@ -52,6 +52,16 @@ dead-process list, and the cluster verdict — "which worker is slow, which
 worker is DEAD, and is the fleet producer- or consumer-bound" answered
 from files alone, no live processes required.
 
+The ``serve-status`` subcommand is the data-service doctor
+(tpu_tfrecord.service): one status round trip to a dispatcher prints one
+``{"event": "worker", ...}`` line per registered decode worker (liveness
+by heartbeat age vs the lease TTL, current shard leases, shards done) and
+a final ``{"event": "service", ...}`` line with the service totals
+(alive/dead workers, active leases, shards done, lease reassignments,
+trace id) — "which worker holds the lease, which worker is dead, and did
+any shard get reassigned" answered from one RPC. Exit 0 = report (dead
+workers are a finding), 2 = dispatcher unreachable.
+
 ``merge-trace OUT F1 F2 ...`` fuses K per-process Chrome trace files
 (``save_chrome_trace`` output) into one Perfetto timeline with a labeled
 track per process (telemetry.merge_chrome_traces) — pid collisions
@@ -602,6 +612,72 @@ def fleet_main(argv: List[str]) -> int:
     return 0
 
 
+def serve_status_main(argv: List[str]) -> int:
+    """The ``serve-status`` subcommand: one status round trip to a data
+    service dispatcher (tpu_tfrecord.service) — one ``worker`` line per
+    registered worker (liveness, current leases, shards done, heartbeat
+    age; the fleet doctor's per-proc rendering vocabulary) and one
+    ``service`` summary line. Exit 0 = report produced (dead workers are a
+    finding, not a failure); 2 = dispatcher unreachable or not a
+    dispatcher."""
+    ap = argparse.ArgumentParser(
+        prog="tfrecord_doctor serve-status",
+        description="Data-service doctor: ask the dispatcher who is "
+        "serving what",
+    )
+    ap.add_argument("dispatcher", help="dispatcher host:port")
+    ap.add_argument(
+        "--timeout", type=float, default=5.0, metavar="SECONDS",
+        help="connect/request deadline (default 5s)",
+    )
+    args = ap.parse_args(argv)
+
+    from tpu_tfrecord import service
+
+    def emit(obj: Dict) -> None:
+        sys.stdout.write(json.dumps(obj, sort_keys=True) + "\n")
+
+    try:
+        status = service.fetch_status(args.dispatcher, timeout=args.timeout)
+    except (OSError, ValueError) as e:
+        emit({"event": "error", "path": args.dispatcher, "error": str(e)})
+        return 2
+    if not status.get("ok") or status.get("role") != "dispatcher":
+        emit({
+            "event": "error", "path": args.dispatcher,
+            "error": status.get("error", f"not a dispatcher: {status!r}"),
+        })
+        return 2
+    for w in status.get("workers", []):
+        emit({
+            "event": "worker",
+            "worker_id": w["worker_id"],
+            "addr": w["addr"],
+            "pid": w["pid"],
+            "alive": w["alive"],
+            "heartbeat_age_s": w["heartbeat_age_s"],
+            "leases": w["leases"],
+            "shards_done": w["shards_done"],
+        })
+    emit({
+        "event": "service",
+        "path": args.dispatcher,
+        "workers": len(status.get("workers", [])),
+        "alive": status.get("alive", 0),
+        "dead": [
+            {"worker_id": w["worker_id"], "addr": w["addr"],
+             "heartbeat_age_s": w["heartbeat_age_s"]}
+            for w in status.get("workers", []) if not w["alive"]
+        ],
+        "lease_ttl_s": status.get("lease_ttl_s"),
+        "active_leases": status.get("active_leases", 0),
+        "shards_done": status.get("shards_done", 0),
+        "lease_reassignments": status.get("lease_reassignments", 0),
+        "trace_id": status.get("trace_id"),
+    })
+    return 0
+
+
 def merge_trace_main(argv: List[str]) -> int:
     """The ``merge-trace`` subcommand: fuse per-process Chrome traces into
     one Perfetto timeline. Exit 0 = merged; 2 = unreadable/malformed input."""
@@ -650,6 +726,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return tune_main(argv[1:])
     if argv and argv[0] == "fleet":
         return fleet_main(argv[1:])
+    if argv and argv[0] == "serve-status":
+        return serve_status_main(argv[1:])
     if argv and argv[0] == "merge-trace":
         return merge_trace_main(argv[1:])
     ap = argparse.ArgumentParser(

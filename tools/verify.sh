@@ -309,6 +309,122 @@ print("fleet smoke OK:", json.dumps({
 }))
 PY
 
+echo "== service smoke (3 workers + 1 consumer + worker SIGKILL -> exactly-once) =="
+# Three decode-worker subprocesses leased by an in-process dispatcher feed
+# one consumer; mid-epoch the worker HOLDING the active lease is SIGKILLed.
+# The epoch must complete with rows byte-identical to a direct local read
+# (exactly-once: nothing duplicated, nothing missing), the dispatcher must
+# count exactly one lease reassignment, no shard may fall back to local
+# reads, and `tfrecord_doctor serve-status` must exit 0 — so the
+# disaggregated data service can't rot.
+env JAX_PLATFORMS=cpu python - <<'PY' || exit 1
+import json, os, signal, subprocess, sys, tempfile, time
+
+import tpu_tfrecord.io as tfio
+from tpu_tfrecord import service
+from tpu_tfrecord.columnar import batch_to_rows
+from tpu_tfrecord.io.dataset import TFRecordDataset
+from tpu_tfrecord.metrics import METRICS
+from tpu_tfrecord.schema import LongType, StringType, StructField, StructType
+
+schema = StructType([StructField("id", LongType(), nullable=False),
+                     StructField("s", StringType())])
+out = os.path.join(tempfile.mkdtemp(prefix="tfr_service_smoke_"), "ds")
+for s in range(6):
+    tfio.write([[i, f"s{i}"] for i in range(s * 30, (s + 1) * 30)],
+               schema, out, mode="append" if s else "overwrite")
+
+def epoch_rows(**kw):
+    ds = TFRecordDataset(out, batch_size=8, schema=schema,
+                         drop_remainder=False, **kw)
+    rows = []
+    with ds.batches() as it:
+        for b in it:
+            rows.extend(batch_to_rows(b, ds.schema))
+            yield_hook(rows, ds)
+    return rows
+
+yield_hook = lambda rows, ds: None
+local = epoch_rows()
+
+d = service.ServiceDispatcher(lease_ttl_s=10.0).start()
+env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+procs = {}
+
+# a failed assert anywhere below must not leak worker subprocesses (their
+# heartbeat loops retry the dead dispatcher forever); the clean
+# terminate/wait path at the bottom still runs first on success
+import atexit
+def _reap():
+    for p in procs.values():
+        if p.poll() is None:
+            p.kill()
+atexit.register(_reap)
+for _ in range(3):
+    p = subprocess.Popen(
+        [sys.executable, "-m", "tpu_tfrecord.service", "worker",
+         "--dispatcher", d.addr],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True, env=env,
+    )
+    ready = json.loads(p.stdout.readline())
+    procs[ready["worker_id"]] = p
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline and len(d.status()["workers"]) < 3:
+    time.sleep(0.05)
+assert len(d.status()["workers"]) == 3, d.status()
+
+# Warm epoch: each worker's FIRST fetch pays dataset construction
+# (seconds on a loaded box), which must not be mistaken for a dead
+# worker by the kill epoch below.
+warm = epoch_rows(service=d.addr, service_deadline_ms=10000)
+assert warm == local, "warm service epoch rows differ from direct local read"
+assert d.status()["lease_reassignments"] == 0, d.status()
+
+killed = []
+def yield_hook(rows, ds):
+    if killed or len(rows) < 40:
+        return
+    holders = [w["worker_id"] for w in d.status()["workers"] if w["leases"]]
+    if holders:  # SIGKILL whoever is serving the consumer RIGHT NOW
+        victim = procs[holders[0]]
+        os.kill(victim.pid, signal.SIGKILL)
+        victim.wait()
+        killed.append(holders[0])
+
+METRICS.reset()
+got = epoch_rows(service=d.addr, service_deadline_ms=10000)
+assert killed, "no active lease ever observed — nothing was killed"
+assert got == local, "service epoch rows differ from direct local read"
+st = d.status()
+assert st["lease_reassignments"] == 1, st
+assert METRICS.counter("service.fallbacks") == 0, "degraded to local reads"
+
+doc = subprocess.run([sys.executable, "tools/tfrecord_doctor.py",
+                      "serve-status", d.addr],
+                     capture_output=True, text=True)
+assert doc.returncode == 0, (doc.returncode, doc.stdout, doc.stderr)
+lines = [json.loads(l) for l in doc.stdout.splitlines() if l.strip()]
+summary = [l for l in lines if l.get("event") == "service"][0]
+assert summary["lease_reassignments"] == 1, summary
+
+for p in procs.values():
+    if p.poll() is None:
+        p.terminate()
+for p in procs.values():
+    if p.poll() is None:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+d.stop()
+print("service smoke OK:", json.dumps({
+    "rows": len(got),
+    "killed_worker": killed[0],
+    "lease_reassignments": st["lease_reassignments"],
+    "reconnects": METRICS.counter("service.reconnects"),
+}))
+PY
+
 echo "== tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log

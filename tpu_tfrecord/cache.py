@@ -213,6 +213,14 @@ def _column_buffers(col: Column) -> List[Tuple[str, np.ndarray]]:
     return out
 
 
+# Public names for the chunk-section serialization primitives: the data
+# service's wire protocol (tpu_tfrecord.service_protocol) frames decoded
+# chunks with exactly the cache container's section layout and per-section
+# CRCs, so both serializers stay one implementation.
+column_buffers = _column_buffers
+section_crc = _section_crc
+
+
 class CachedShard:
     """One validated, mmap'd cache entry: rebuilds ColumnarBatch chunks as
     zero-copy numpy views (bytes-like blobs are the one copy — downstream

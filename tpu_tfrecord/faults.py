@@ -57,12 +57,22 @@ FAULT_KINDS = (
     "stall",
     "rename_race",
     "flaky_listing",
+    "disconnect",
 )
 
 #: ops a rule may target. ``read`` covers read()/readinto() on handles the
 #: wrapped FS opened; ``open`` covers the open call itself; ``rename`` and
-#: ``listdir`` cover the write/commit and discovery sides.
-FAULT_OPS = ("open", "read", "rename", "listdir")
+#: ``listdir`` cover the write/commit and discovery sides. ``connect`` and
+#: ``recv`` are the SOCKET seams of the data service
+#: (tpu_tfrecord.service_protocol): the path a rule matches is the peer
+#: address string ("host:port"); ``transient_error``/``permanent_error``
+#: on connect model refused connections, ``stall`` models a hung peer
+#: (bounded, same injectable sleep), ``short_read`` caps one recv (the
+#: partial-segment scenario every recv loop must refill past), and
+#: ``disconnect`` closes the socket mid-frame — the short-frame scenario
+#: the protocol must convert into a loud ProtocolError, never into
+#: truncated data.
+FAULT_OPS = ("open", "read", "rename", "listdir", "connect", "recv")
 
 
 class InjectedFault(OSError):
@@ -236,7 +246,36 @@ class FaultPlan:
             elif kind in ("transient_error", "permanent_error", "flaky_listing"):
                 self._raise_for(fault)
             # rename_race is handled at the rename call site (the rename
-            # must LAND before the error) — see ChaosFS.rename
+            # must LAND before the error) — see ChaosFS.rename;
+            # disconnect is socket-only — see apply_socket
+        return cap
+
+    def apply_socket(
+        self, op: str, addr: str, sock=None, size: Optional[int] = None
+    ) -> Optional[int]:
+        """Run the plan for one socket call (``connect``/``recv`` against
+        the peer address): stalls sleep, errors raise, short reads return
+        the capped recv size, and ``disconnect`` CLOSES the socket before
+        raising — so the local side observes the same half-dead-peer state
+        a real mid-frame death leaves behind."""
+        cap: Optional[int] = None
+        for fault in self.decide(op, addr):
+            kind = fault["kind"]
+            if kind == "stall":
+                self.sleep(fault["_rule"].stall_ms / 1000.0)
+            elif kind == "short_read":
+                c = fault["_rule"].cap_bytes
+                if size is None or size < 0 or size > c:
+                    cap = c if cap is None else min(cap, c)
+            elif kind == "disconnect":
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                self._raise_for(fault)
+            else:
+                self._raise_for(fault)
         return cap
 
 
@@ -339,14 +378,18 @@ def install_chaos(plan: FaultPlan):
     ChaosFS-wrapped (scheme'd paths AND the LocalFS the writer uses),
     ``fs.local_open`` (the raw-open seam wire.open_compressed uses for
     plain paths) and ``io.dataset._open_local`` (the mmap fast path's
-    seam) open through the plan. Restores everything on exit and releases
-    any in-flight default-sleep stalls."""
+    seam) open through the plan, and the data service's socket seams
+    (``service_protocol`` connect/recv) consult it for ``connect``/
+    ``recv`` rules. Restores everything on exit and releases any
+    in-flight default-sleep stalls."""
     from tpu_tfrecord import fs as _fs
+    from tpu_tfrecord import service_protocol as _sp
     from tpu_tfrecord.io import dataset as _dataset
 
     orig_filesystem_for = _fs.filesystem_for
     orig_local_open = _fs.local_open
     orig_open_local = _dataset._open_local
+    orig_chaos_plan = _sp._CHAOS_PLAN
 
     def chaos_filesystem_for(path: str):
         return ChaosFS(orig_filesystem_for(path), plan)
@@ -360,10 +403,14 @@ def install_chaos(plan: FaultPlan):
     _fs.filesystem_for = chaos_filesystem_for
     _fs.local_open = chaos_local_open
     _dataset._open_local = chaos_local_open
+    # the socket seam: service_protocol consults this plan at every
+    # connect and recv for the duration of the block
+    _sp._CHAOS_PLAN = plan
     try:
         yield plan
     finally:
         _fs.filesystem_for = orig_filesystem_for
         _fs.local_open = orig_local_open
         _dataset._open_local = orig_open_local
+        _sp._CHAOS_PLAN = orig_chaos_plan
         plan.release()

@@ -200,6 +200,13 @@ class TFRecordDataset:
             else DatasetReader(paths, **option_kwargs)
         )
         self.options = self._reader.options
+        # The ORIGINAL source spec (pre-discovery), kept for the data
+        # service's job spec: decode workers re-discover the same shard
+        # list from it (and prove agreement via the shard-list digest).
+        self.source_paths = [
+            os.fspath(p)
+            for p in (paths if isinstance(paths, (list, tuple)) else [paths])
+        ]
         # Flight recorder opt-in (tpu_tfrecord.telemetry): the recorder is
         # process-global (spans come from prefetch workers, the stall
         # guard, and writer threads on one shared timeline), so any
@@ -236,9 +243,7 @@ class TFRecordDataset:
         self.process_index = process_index
         self.process_count = process_count
         self._fingerprint: Optional[str] = None
-        self.shards = [
-            sh for i, sh in enumerate(all_shards) if i % process_count == process_index
-        ]
+        self.shards = p.interleave(all_shards, process_index, process_count)
         self._decoder = ColumnarDecoder(self._data_schema, self.options.record_type)
         # hash_buckets fuses categorical hashing into the native decode;
         # pack pushes column-group assembly down too ([B, K] matrices).
@@ -346,10 +351,7 @@ class TFRecordDataset:
                     max_bytes=self.options.cache_max_bytes,
                     expect_columns=expect,
                 )
-                dtypes = {f.name: f.data_type for f in self.schema}
-                for gname, members_ in self.pack.items():
-                    dtypes[gname] = self._data_schema[members_[0]].data_type
-                self._cache_dtypes = dtypes
+                self._cache_dtypes = self.chunk_dtypes()
 
     # -- chunked decode stream with positional accounting --------------------
     #
@@ -884,7 +886,16 @@ class TFRecordDataset:
         native decoder releases the GIL) and chunks are re-emitted in exact
         stream order; memory is bounded by num_workers in-flight shards.
         With a ``control`` (autotune.PipelineControl) the pool path is
-        taken even at num_workers=1 so the pool can grow mid-epoch."""
+        taken even at num_workers=1 so the pool can grow mid-epoch.
+        With ``options.service`` set, chunks are FETCHED from the
+        disaggregated data service instead of decoded here (same tuples,
+        same positions — decode parallelism lives in the worker fleet, so
+        ``num_workers`` and the pool control do not apply)."""
+        if self.options.service is not None:
+            yield from self._service_chunks(
+                state, stop_event or threading.Event()
+            )
+            return
         if self.num_workers <= 1 and control is None:
             for epoch, pos, shard_idx, skip in self._shard_tasks(state):
                 yield from self._decode_shard(epoch, pos, shard_idx, skip)
@@ -892,6 +903,34 @@ class TFRecordDataset:
         yield from _parallel_chunks(
             self, state, stop_event or threading.Event(), control
         )
+
+    def _service_chunks(self, state: IteratorState, stop) -> Iterator[tuple]:
+        """Service-backed chunk source (tpu_tfrecord.service): each shard's
+        chunks stream from a leased decode worker, with exactly-once
+        dedupe, reconnect-with-backoff across worker/dispatcher death, and
+        graceful degradation to ``_decode_shard`` when the service stays
+        unreachable — so resume states are interchangeable between
+        service-backed and local iterators by construction."""
+        from tpu_tfrecord import service as _service
+
+        client = _service.ServiceClient(self)
+        try:
+            for epoch, pos, shard_idx, skip in self._shard_tasks(state):
+                if stop.is_set():
+                    return
+                yield from client.shard_chunks(epoch, pos, shard_idx, skip, stop)
+        finally:
+            client.close()
+
+    def chunk_dtypes(self) -> Dict[str, Any]:
+        """name -> schema DataType for every column a decoded chunk can
+        carry (requested fields + pack group matrices): the reconstruction
+        map shared by the epoch cache (``CachedShard.chunk_batch``) and
+        the data service's chunk deserializer."""
+        dtypes: Dict[str, Any] = {f.name: f.data_type for f in self.schema}
+        for gname, members in self.pack.items():
+            dtypes[gname] = self._data_schema[members[0]].data_type
+        return dtypes
 
     def _attach_partition_chunk(self, chunk: ColumnarBatch, cursor: int) -> None:
         """Partition values are constant within a shard: materialize them as
@@ -1462,7 +1501,16 @@ class CheckpointableIterator:
         self._control = None
         self.autotune = None
         pulse_interval = dataset.options.pulse_interval_s
-        if dataset.options.autotune == "on":
+        if dataset.options.autotune == "on" and dataset.options.service is not None:
+            from tpu_tfrecord.metrics import logger as _logger
+
+            _logger.warning(
+                "autotune disabled: this iterator is service-backed "
+                "(options.service=%r) — decode parallelism lives in the "
+                "worker fleet, not in a local pool the controller could "
+                "resize", dataset.options.service,
+            )
+        elif dataset.options.autotune == "on":
             from tpu_tfrecord import autotune as _autotune
 
             self._control = _autotune.PipelineControl(

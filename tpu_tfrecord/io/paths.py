@@ -196,6 +196,27 @@ def discover_shards(paths) -> List[Shard]:
     return shards
 
 
+def interleave(items: Sequence, slot: int, count: int) -> List:
+    """The ONE owner of the deterministic interleaved assignment: slot ``s``
+    of ``count`` takes items ``i`` with ``i % count == s``. Every layer that
+    splits the global shard order — per-host assignment (tpu.mesh), the
+    dataset's process slot, and the data-service dispatcher's shard→worker
+    leases — routes through this so they can never disagree about who owns
+    what."""
+    if count < 1:
+        raise ValueError(f"count must be >= 1, got {count}")
+    if not 0 <= slot < count:
+        raise ValueError(f"slot must be in [0, {count}), got {slot}")
+    return [it for i, it in enumerate(items) if i % count == slot]
+
+
+def interleave_owner(index: int, count: int) -> int:
+    """The inverse view of ``interleave``: which slot owns item ``index``."""
+    if count < 1:
+        raise ValueError(f"count must be >= 1, got {count}")
+    return index % count
+
+
 def partition_columns_of(shards: Sequence[Shard]) -> List[str]:
     """Union of partition column names across shards, in first-seen order."""
     cols: List[str] = []

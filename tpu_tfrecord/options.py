@@ -159,6 +159,33 @@ class TFRecordOptions:
         is on and no ``pulse_interval_s`` is set (default 1.0s; a
         configured pulse interval wins — the controller always runs at
         pulse boundaries).
+      - service: disaggregated data service (tpu_tfrecord.service).
+        ``"host:port"`` of the dispatcher makes this dataset's iterators
+        fetch decoded chunks from leased decode-worker processes instead
+        of decoding locally — batches, checkpoints, and shuffling are
+        byte-identical either way (the service is an alternative chunk
+        source under the same pipeline). None (default) = decode locally.
+      - service_lease_ttl_s: dispatcher-side lease TTL — a worker whose
+        heartbeat is older than this loses its leases and its shards are
+        reassigned. Consumed by the dispatcher (``python -m
+        tpu_tfrecord.service dispatcher`` defaults its ``--lease-ttl-s``
+        from this option's default); carried here so the whole failure
+        model is configured in one vocabulary. Consumers use it only as
+        the suspect-aging default until the first route reply carries the
+        dispatcher's REAL TTL, which then wins — a mis-set local value
+        cannot desynchronize the client from the fleet's actual
+        reassignment clock.
+      - service_deadline_ms: consumer-side per-socket-op deadline
+        (connect, request, each recv). A worker or dispatcher that
+        produces nothing for this long is treated as dead for THIS
+        attempt: the consumer re-routes (excluding the silent worker) and
+        resumes from its acked offset.
+      - service_fallback_ms: how long a shard may make NO progress through
+        the service (across reconnects and re-routes) before the consumer
+        degrades to a direct local read of the same shard — byte-identical
+        rows, counted in ``service.fallbacks``. After a fallback, later
+        shards probe the service with one quick attempt until it heals.
+        None = never fall back (retry forever).
     """
 
     record_type: RecordType = RecordType.EXAMPLE
@@ -189,6 +216,10 @@ class TFRecordOptions:
     telemetry_role: Optional[str] = None
     autotune: str = "off"
     autotune_interval_s: Optional[float] = None
+    service: Optional[str] = None
+    service_lease_ttl_s: float = 10.0
+    service_deadline_ms: float = 5000.0
+    service_fallback_ms: Optional[float] = 30000.0
 
     _KNOWN_KEYS = (
         "recordType",
@@ -242,6 +273,13 @@ class TFRecordOptions:
         "autotune",
         "autotune_interval_s",
         "autotuneIntervalS",
+        "service",
+        "service_lease_ttl_s",
+        "serviceLeaseTtlS",
+        "service_deadline_ms",
+        "serviceDeadlineMs",
+        "service_fallback_ms",
+        "serviceFallbackMs",
     )
 
     ON_CORRUPT_POLICIES = ("raise", "skip_record", "skip_shard")
@@ -410,6 +448,31 @@ class TFRecordOptions:
             autotune_interval_s = float(autotune_interval_s)
             if autotune_interval_s <= 0:
                 raise ValueError("autotune_interval_s must be > 0 (or None)")
+        service = merged.pop("service", None)
+        if service is not None:
+            service = str(service)
+            from tpu_tfrecord.service_protocol import parse_addr
+
+            parse_addr(service)  # loud on anything that isn't host:port
+        service_lease_ttl_s = float(
+            merged.pop("service_lease_ttl_s", merged.pop("serviceLeaseTtlS", 10.0))
+        )
+        if service_lease_ttl_s <= 0:
+            raise ValueError("service_lease_ttl_s must be > 0")
+        service_deadline_ms = float(
+            merged.pop(
+                "service_deadline_ms", merged.pop("serviceDeadlineMs", 5000.0)
+            )
+        )
+        if service_deadline_ms <= 0:
+            raise ValueError("service_deadline_ms must be > 0")
+        service_fallback_ms = merged.pop(
+            "service_fallback_ms", merged.pop("serviceFallbackMs", 30000.0)
+        )
+        if service_fallback_ms is not None:
+            service_fallback_ms = float(service_fallback_ms)
+            if service_fallback_ms < 0:
+                raise ValueError("service_fallback_ms must be >= 0 (or None)")
         if merged:
             import difflib
 
@@ -454,6 +517,10 @@ class TFRecordOptions:
             telemetry_role=telemetry_role,
             autotune=autotune,
             autotune_interval_s=autotune_interval_s,
+            service=service,
+            service_lease_ttl_s=service_lease_ttl_s,
+            service_deadline_ms=service_deadline_ms,
+            service_fallback_ms=service_fallback_ms,
         )
 
     def with_schema(self, schema: StructType) -> "TFRecordOptions":

@@ -1,0 +1,1213 @@
+"""Disaggregated data service: dispatcher + decode workers + trainer consumers.
+
+The single biggest scale unlock named by ROADMAP #1, straight from "tf.data
+service: A Case for Disaggregating ML Input Data Processing" (PAPERS.md):
+decode CPU must scale independently of accelerator count. A **dispatcher**
+process owns shard->worker leasing (the same deterministic interleaved
+assignment as per-host shard selection — ``io.paths.interleave``, one
+owner); N **decode workers** read/decode/pack shards — serving from the
+columnar epoch cache when warm, populating it on miss via
+``CachePopulator``'s atomic staging — and stream length-framed,
+CRC-stamped chunks (``service_protocol``) to M **trainer consumers**; the
+consumer side is just an alternative chunk source for
+``TFRecordDataset._chunk_stream``, so batches, checkpoints, shuffling, and
+every downstream layer are byte-identical to local reads.
+
+Robustness is the contract, not a feature:
+
+- **Worker death**: workers heartbeat the dispatcher; a SIGKILLed worker's
+  lease expires (heartbeat age > ``lease_ttl_s``, the same
+  staleness-by-heartbeat model the fleet aggregator uses) and the shard is
+  re-routed to a surviving worker (``service.lease_reassignments``).
+  Exactly-once delivery is CONSUMER-owned: the consumer tracks its
+  position (``IteratorState`` semantics — absolute record offsets within
+  the shard), re-requests from its acked offset, and drops/slices any
+  redelivered prefix (``service.redelivered_dropped``) — redelivery can
+  never double-count, and a worker that dies mid-chunk can never leave a
+  hole, because the next worker decodes the same deterministic stream.
+- **Dispatcher death**: every assignment-state mutation is journaled to an
+  atomically-rewritten file (``telemetry.atomic_write_bytes``); a
+  restarted dispatcher replays it (workers, leases, done set,
+  reassignment count, trace identity) and workers re-register through
+  their heartbeat loop. Consumers ride ``RetryPolicy``-shaped backoff
+  through the outage and resume from their acked position.
+- **Service unreachable**: past ``service_fallback_ms`` without progress
+  the consumer degrades to DIRECT LOCAL reads of the same shard
+  (``service.fallbacks``) — byte-identical rows either way, because the
+  fallback is literally ``TFRecordDataset._decode_shard``. Later shards
+  probe the service with one quick attempt until it heals.
+
+Every socket hop rides ``service_protocol`` framing (masked-CRC control
+frames; chunk sections CRC-stamped with the cache container's own
+primitives) and is fault-injectable through the seeded ``FaultPlan``
+socket seam (``connect``/``recv`` rules), same replayable ledger as file
+faults.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from tpu_tfrecord import service_protocol as sp
+from tpu_tfrecord import telemetry, wire
+from tpu_tfrecord.columnar import slice_batch
+from tpu_tfrecord.io.paths import interleave_owner
+from tpu_tfrecord.metrics import METRICS, log_salvage_event, logger
+
+PROTO_VERSION = sp.PROTO_VERSION
+
+#: worker -> dispatcher heartbeat cadence, as a fraction of the lease TTL
+#: (3 beats per TTL: one lost datagram never expires a healthy lease).
+HEARTBEAT_FRACTION = 3.0
+
+DEFAULT_LEASE_TTL_S = 10.0
+
+#: constructed-dataset cache entries a decode worker keeps (one per job
+#: digest); beyond this the oldest job's dataset is evicted.
+MAX_CACHED_JOBS = 4
+
+
+class _ConnTracker:
+    """Live accepted-connection registry for a serving loop: ``stop`` must
+    close every open connection, not just the listener — a process death
+    closes all fds at once, and an in-process stop() (tests, clean
+    shutdown) has to look the same to peers AND release the port for an
+    immediate same-port restart."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._conns: set = set()
+
+    def track(self, conn: socket.socket) -> None:
+        with self._lock:
+            self._conns.add(conn)
+
+    def untrack(self, conn: socket.socket) -> None:
+        with self._lock:
+            self._conns.discard(conn)
+
+    def close_all(self) -> None:
+        with self._lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+class ServiceUnavailable(ConnectionError):
+    """The dispatcher answered but cannot serve (e.g. no alive workers) —
+    transport-shaped, so consumer retry/fallback nets handle it."""
+
+
+class ServiceSpecError(RuntimeError):
+    """Worker and consumer disagree about the dataset (shard list digest,
+    fused-decode availability). Loud by design: divergent views of the
+    data must never be papered over by a fallback."""
+
+
+def shards_digest(shards) -> str:
+    """Identity of the GLOBAL shard list ((path, size) pairs, discovery
+    order) — consumer and worker must agree before any bytes flow."""
+    blob = json.dumps(
+        [(sh.path, sh.size) for sh in shards], sort_keys=True
+    ).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def build_job_spec(ds) -> Dict[str, Any]:
+    """Everything a decode worker needs to reproduce this dataset's chunk
+    stream byte-for-byte: source paths, the RESOLVED schema (no inference
+    divergence), requested columns, decode fusions, corruption policy, and
+    the global shard-list digest. Options that only change how chunks are
+    produced locally (prefetch, workers, mmap, readahead, stall
+    thresholds) are deliberately absent — they are the worker's own
+    business."""
+    opts = ds.options
+    spec: Dict[str, Any] = {
+        "proto": PROTO_VERSION,
+        "paths": ds.source_paths,
+        "columns": [f.name for f in ds.schema],
+        "schema": ds._reader.schema().to_json(),
+        "record_type": opts.record_type.value,
+        "verify_crc": opts.verify_crc,
+        "on_corrupt": opts.on_corrupt,
+        "max_corrupt_records": opts.max_corrupt_records,
+        "corrupt_fallback": opts.corrupt_fallback,
+        "on_stall": opts.on_stall,
+        "batch_size": ds.batch_size,
+        "slab_bytes": ds.slab_bytes,
+        "max_record_bytes": ds.max_record_bytes,
+        "hash_buckets": ds.hash_buckets,
+        "pack": ds.pack,
+        "shards_digest": shards_digest(ds._reader.shards),
+    }
+    if ds.hash_buckets or ds.pack:
+        # fused decode changes which COLUMNS a chunk carries (members fold
+        # into group matrices) — both sides must agree
+        spec["fused"] = ds._native_decoder is not None
+    return spec
+
+
+def job_digest(spec: Dict[str, Any]) -> str:
+    return hashlib.sha256(
+        json.dumps(spec, sort_keys=True).encode()
+    ).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# Dispatcher
+# ---------------------------------------------------------------------------
+
+
+class _WorkerInfo:
+    __slots__ = ("worker_id", "addr", "pid", "beat")
+
+    def __init__(self, worker_id: str, addr: str, pid: int, beat: float):
+        self.worker_id = worker_id
+        self.addr = addr
+        self.pid = pid
+        self.beat = beat
+
+
+class ServiceDispatcher:
+    """Owns shard->worker leasing and nothing else — no data bytes ever
+    flow through it. All mutable assignment state (workers, leases, done
+    set, reassignment count, trace identity) is journaled via
+    ``atomic_write_bytes`` on every mutation, so a crash loses at most the
+    heartbeat freshness (which workers re-supply within one TTL).
+
+    Lease model: ``route`` picks the owner among the ALIVE workers with the
+    interleaved assignment (``interleave_owner`` over the sorted alive
+    list — the same one owner per-host shard selection uses). A re-route
+    of a leased shard counts as a reassignment only when the previous
+    lessee is dead or explicitly excluded by the consumer that watched it
+    die; assignment drift from fleet growth is rebalancing, not failure.
+    """
+
+    def __init__(
+        self,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        journal: Optional[str] = None,
+        lease_ttl_s: float = DEFAULT_LEASE_TTL_S,
+        clock=time.monotonic,
+    ):
+        if lease_ttl_s <= 0:
+            raise ValueError("lease_ttl_s must be > 0")
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.journal = journal
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._workers: Dict[str, _WorkerInfo] = {}
+        self._leases: Dict[str, str] = {}  # "job/shard_path" -> worker_id
+        self._done: Dict[str, str] = {}
+        self._reassignments = 0
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._conns = _ConnTracker()
+        self._ctx = telemetry.current_context().with_role("dispatcher")
+        if journal is not None and os.path.exists(journal):
+            self._replay_journal(journal)
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(64)
+        self.addr = sp.format_addr(host, self._srv.getsockname()[1])
+
+    # -- journal ------------------------------------------------------------
+
+    def _replay_journal(self, path: str) -> None:
+        """Restore assignment state from a previous incarnation. Journaled
+        workers get a fresh heartbeat grace of one TTL — they must
+        re-heartbeat (their loop re-registers on ``known: false``) or they
+        expire exactly like a SIGKILLed worker. The journaled trace
+        identity is re-adopted so the restarted dispatcher stays part of
+        the same logical run (one trace id across the restart)."""
+        try:
+            with open(path, "rb") as fh:
+                obj = json.loads(fh.read().decode("utf-8"))
+        except (OSError, ValueError) as e:
+            raise RuntimeError(f"unreadable dispatcher journal {path}: {e}")
+        now = self._clock()
+        for wid, info in dict(obj.get("workers", {})).items():
+            self._workers[str(wid)] = _WorkerInfo(
+                str(wid), str(info["addr"]), int(info.get("pid", 0)), now
+            )
+        self._leases = {str(k): str(v) for k, v in dict(obj.get("leases", {})).items()}
+        self._done = {str(k): str(v) for k, v in dict(obj.get("done", {})).items()}
+        self._reassignments = int(obj.get("reassignments", 0))
+        trace = obj.get("trace")
+        if isinstance(trace, dict):
+            self._ctx = telemetry.adopt(
+                telemetry.TraceContext.from_json(trace).with_role("dispatcher")
+            )
+
+    def _journal_locked(self) -> None:
+        if self.journal is None:
+            return
+        payload = {
+            "version": 1,
+            "lease_ttl_s": self.lease_ttl_s,
+            "workers": {
+                w.worker_id: {"addr": w.addr, "pid": w.pid}
+                for w in self._workers.values()
+            },
+            "leases": self._leases,
+            "done": self._done,
+            "reassignments": self._reassignments,
+            "trace": self._ctx.to_json(),
+        }
+        try:
+            telemetry.atomic_write_bytes(
+                self.journal, json.dumps(payload, sort_keys=True).encode()
+            )
+        except OSError as e:
+            # a journal write failure must not take the control plane down
+            # mid-epoch — but it must be visible
+            METRICS.count("service.journal_errors")
+            logger.warning("dispatcher journal write failed: %s", e)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "ServiceDispatcher":
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        self._conns.close_all()
+        # Wait out the accept thread: while it is blocked in accept(2) the
+        # kernel keeps the listening socket's file description — and the
+        # PORT — alive past close(), and a same-port restart (the
+        # dispatcher-crash story) would race EADDRINUSE against its 0.2s
+        # poll. Bounded: the poll timeout guarantees exit.
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=2.0)
+
+    def __enter__(self) -> "ServiceDispatcher":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _accept_loop(self) -> None:
+        self._srv.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _peer = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            self._conns.track(conn)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        peer = "client"
+        try:
+            conn.settimeout(max(1.0, self.lease_ttl_s * 4))
+            while not self._stop.is_set():
+                msg = sp.recv_msg(conn, peer, allow_eof=True)
+                if msg is None:
+                    return
+                sp.send_msg(conn, self._handle(msg))
+        except (OSError, sp.ProtocolError):
+            return
+        finally:
+            self._conns.untrack(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- request handling ---------------------------------------------------
+
+    def _handle(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        op = msg.get("op")
+        if msg.get("proto", PROTO_VERSION) != PROTO_VERSION:
+            return {"error": "proto_mismatch", "proto": PROTO_VERSION}
+        try:
+            if op == "register_worker":
+                return self._op_register(msg)
+            if op == "heartbeat":
+                return self._op_heartbeat(msg)
+            if op == "route":
+                return self._op_route(msg)
+            if op == "shard_done":
+                return self._op_shard_done(msg)
+            if op == "status":
+                return self.status()
+            if op == "ping":
+                return {"ok": True, "role": "dispatcher"}
+            return {"error": f"unknown op {op!r}"}
+        except (KeyError, TypeError, ValueError) as e:
+            return {"error": f"malformed {op!r} request: {e}"}
+
+    def _alive_locked(self, now: float) -> List[str]:
+        return sorted(
+            w.worker_id
+            for w in self._workers.values()
+            if now - w.beat <= self.lease_ttl_s
+        )
+
+    def _op_register(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        wid = str(msg["worker_id"])
+        with self._lock:
+            self._workers[wid] = _WorkerInfo(
+                wid, str(msg["addr"]), int(msg.get("pid", 0)), self._clock()
+            )
+            self._journal_locked()
+        return {
+            "ok": True,
+            "worker_id": wid,
+            "lease_ttl_s": self.lease_ttl_s,
+            "trace": self._ctx.to_json(),
+        }
+
+    def _op_heartbeat(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        wid = str(msg["worker_id"])
+        with self._lock:
+            info = self._workers.get(wid)
+            if info is not None:
+                info.beat = self._clock()
+        # known=False sends the worker back through register (the
+        # journal-less restart path)
+        return {"ok": True, "known": info is not None}
+
+    def _op_route(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        job = str(msg["job"])
+        shard_path = str(msg["path"])
+        shard_index = int(msg["shard_index"])
+        exclude = {str(w) for w in msg.get("exclude", [])}
+        key = f"{job}/{shard_path}"
+        with self._lock:
+            now = self._clock()
+            alive = self._alive_locked(now)
+            candidates = [w for w in alive if w not in exclude]
+            if not candidates:
+                # every alive worker is excluded: better a possibly-flaky
+                # worker than no route at all (the consumer's fallback
+                # budget still bounds the pain)
+                candidates = alive
+            if not candidates:
+                return {"error": "no_workers"}
+            wid = candidates[interleave_owner(shard_index, len(candidates))]
+            prev = self._leases.get(key)
+            if prev is not None and prev != wid:
+                if prev not in alive or prev in exclude:
+                    self._reassignments += 1
+                    METRICS.count("service.lease_reassignments")
+                    telemetry.instant(
+                        "service.lease_reassigned",
+                        shard=shard_path, from_worker=prev, to_worker=wid,
+                    )
+            if prev != wid:
+                self._leases[key] = wid
+                self._journal_locked()
+            return {
+                "ok": True,
+                "worker": self._workers[wid].addr,
+                "worker_id": wid,
+                # the dispatcher's REAL ttl, so consumers age their
+                # suspect lists on the fleet's actual reassignment clock
+                # rather than trusting a local option to match it
+                "lease_ttl_s": self.lease_ttl_s,
+            }
+
+    def _op_shard_done(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        key = f"{msg['job']}/{msg['path']}"
+        with self._lock:
+            wid = self._leases.pop(key, None) or str(msg.get("worker_id", ""))
+            if key not in self._done:
+                self._done[key] = wid
+                METRICS.count("service.shards_done")
+            self._journal_locked()
+        return {"ok": True}
+
+    def status(self) -> Dict[str, Any]:
+        """The serve-status picture: one entry per worker (lease count,
+        shards done, heartbeat age) + service totals."""
+        with self._lock:
+            now = self._clock()
+            alive = set(self._alive_locked(now))
+            done_by: Dict[str, int] = {}
+            for wid in self._done.values():
+                done_by[wid] = done_by.get(wid, 0) + 1
+            leases_by: Dict[str, List[str]] = {}
+            for key, wid in self._leases.items():
+                leases_by.setdefault(wid, []).append(key.split("/", 1)[1])
+            workers = [
+                {
+                    "worker_id": w.worker_id,
+                    "addr": w.addr,
+                    "pid": w.pid,
+                    "alive": w.worker_id in alive,
+                    "heartbeat_age_s": round(now - w.beat, 3),
+                    "leases": sorted(leases_by.get(w.worker_id, [])),
+                    "shards_done": done_by.get(w.worker_id, 0),
+                }
+                for w in sorted(self._workers.values(), key=lambda w: w.worker_id)
+            ]
+            return {
+                "ok": True,
+                "role": "dispatcher",
+                "addr": self.addr,
+                "lease_ttl_s": self.lease_ttl_s,
+                "workers": workers,
+                "alive": len(alive),
+                "shards_done": len(self._done),
+                "active_leases": len(self._leases),
+                "lease_reassignments": self._reassignments,
+                "trace_id": self._ctx.trace_id,
+            }
+
+
+# ---------------------------------------------------------------------------
+# Decode worker
+# ---------------------------------------------------------------------------
+
+
+class DecodeWorker:
+    """One decode process: registers with the dispatcher (adopting the
+    dispatcher's trace as its parent, so spool snapshots and merged
+    timelines correlate), heartbeats at TTL/3, and serves ``fetch``
+    requests by streaming a shard's decoded chunks — through the columnar
+    epoch cache when the worker has one configured (serve on hit,
+    ``CachePopulator`` atomic staging on miss), exactly like a local read.
+
+    ``options`` carries the WORKER-LOCAL knobs (cache mode/dir/budget,
+    stall-guard thresholds, trace) — everything that changes decoded ROWS
+    comes from the consumer's job spec instead, so a worker can serve any
+    compatible job."""
+
+    def __init__(
+        self,
+        dispatcher_addr: str,
+        options=None,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        worker_id: Optional[str] = None,
+        role: str = "decode_worker",
+        clock=time.monotonic,
+        sleep=None,
+    ):
+        self.dispatcher_addr = str(dispatcher_addr)
+        self._options = options
+        self._role = role
+        self._clock = clock
+        self._stop = threading.Event()
+        self._sleep = sleep if sleep is not None else self._stop.wait
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(64)
+        self.addr = sp.format_addr(host, self._srv.getsockname()[1])
+        self.worker_id = worker_id or f"{host}:{self._srv.getsockname()[1]}"
+        self.lease_ttl_s = DEFAULT_LEASE_TTL_S
+        self._registered = threading.Event()
+        self._datasets: Dict[str, Tuple[Any, Dict[str, int]]] = {}
+        self._ds_lock = threading.Lock()
+        self._build_lock = threading.Lock()
+        self._threads: List[threading.Thread] = []
+        self._conns = _ConnTracker()
+
+    def start(self) -> "DecodeWorker":
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+        self._accept_thread.start()
+        beat = threading.Thread(target=self._beat_loop, daemon=True)
+        beat.start()
+        self._threads += [self._accept_thread, beat]
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        self._conns.close_all()
+        # free the data port deterministically (see ServiceDispatcher.stop);
+        # the beat thread is NOT joined — it may be mid-RPC to a dead
+        # dispatcher with a seconds-scale timeout, and it holds no port
+        t = getattr(self, "_accept_thread", None)
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
+
+    def __enter__(self) -> "DecodeWorker":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def wait_registered(self, timeout: Optional[float] = None) -> bool:
+        return self._registered.wait(timeout)
+
+    # -- dispatcher side ----------------------------------------------------
+
+    def _beat_loop(self) -> None:
+        """Register, then heartbeat at TTL/3 forever. Any transport error
+        (dispatcher crashed/restarting) just backs off and retries — a
+        restarted dispatcher answers ``known: false`` until we re-register,
+        which this loop does on the next beat."""
+        conn: Optional[socket.socket] = None
+        registered = False
+        backoff = 0.05
+        while not self._stop.is_set():
+            try:
+                if conn is None:
+                    conn = sp.connect(self.dispatcher_addr, timeout=5.0)
+                if not registered:
+                    reply = sp.request(
+                        conn,
+                        self.dispatcher_addr,
+                        {
+                            "op": "register_worker",
+                            "proto": PROTO_VERSION,
+                            "worker_id": self.worker_id,
+                            "addr": self.addr,
+                            "pid": os.getpid(),
+                        },
+                    )
+                    if reply.get("error"):
+                        raise ServiceUnavailable(str(reply["error"]))
+                    self.lease_ttl_s = float(
+                        reply.get("lease_ttl_s", DEFAULT_LEASE_TTL_S)
+                    )
+                    trace = reply.get("trace")
+                    if isinstance(trace, dict):
+                        telemetry.adopt_child_from_json(trace, role=self._role)
+                    registered = True
+                    self._registered.set()
+                    METRICS.count("service.registrations")
+                else:
+                    reply = sp.request(
+                        conn,
+                        self.dispatcher_addr,
+                        {
+                            "op": "heartbeat",
+                            "proto": PROTO_VERSION,
+                            "worker_id": self.worker_id,
+                        },
+                    )
+                    if not reply.get("known", False):
+                        registered = False
+                        continue
+                backoff = 0.05
+                self._sleep(max(0.05, self.lease_ttl_s / HEARTBEAT_FRACTION))
+            except (OSError, sp.ProtocolError, ServiceUnavailable):
+                if conn is not None:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    conn = None
+                registered = False
+                self._sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
+
+    # -- data side ----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        self._srv.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _peer = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            self._conns.track(conn)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        peer = "consumer"
+        try:
+            # sends block under normal consumer backpressure; the timeout
+            # only reaps connections whose peer is wedged outright
+            conn.settimeout(300.0)
+            while not self._stop.is_set():
+                msg = sp.recv_msg(conn, peer, allow_eof=True)
+                if msg is None:
+                    return
+                if msg.get("proto", PROTO_VERSION) != PROTO_VERSION:
+                    # same loud rejection as the dispatcher's _handle: a
+                    # version-skewed peer must never receive chunks whose
+                    # section layout it would mis-parse
+                    sp.send_msg(conn, {"op": "error", "kind": "proto_mismatch",
+                                       "error": f"worker speaks proto "
+                                       f"{PROTO_VERSION}, peer sent "
+                                       f"{msg.get('proto')!r}"})
+                elif msg.get("op") == "fetch":
+                    if not self._handle_fetch(conn, msg, peer):
+                        return
+                elif msg.get("op") == "ping":
+                    sp.send_msg(conn, {"ok": True, "worker_id": self.worker_id})
+                else:
+                    sp.send_msg(conn, {"op": "error", "kind": "bad_request",
+                                       "error": f"unknown op {msg.get('op')!r}"})
+        except (OSError, sp.ProtocolError):
+            return  # consumer went away — its dedupe makes this safe
+        finally:
+            self._conns.untrack(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dataset_for(self, spec: Dict[str, Any]):
+        """Build (and cache by job digest) the dataset that reproduces the
+        consumer's chunk stream, merged with this worker's own local knobs
+        (epoch cache, stall thresholds)."""
+        digest = job_digest(spec)
+        with self._ds_lock:
+            hit = self._datasets.get(digest)
+            if hit is not None:
+                return hit
+        # One build at a time: the acceptance topology (2 consumers, same
+        # job) guarantees near-simultaneous cold fetches, which must not
+        # each pay the seconds-long construction; the second fetch waits
+        # here and takes the cache hit (the keepalive in _handle_fetch
+        # covers the wait on the consumer's deadline).
+        with self._build_lock:
+            with self._ds_lock:
+                hit = self._datasets.get(digest)
+                if hit is not None:
+                    return hit
+            return self._build_dataset(spec, digest)
+
+    def _build_dataset(self, spec: Dict[str, Any], digest: str):
+        from tpu_tfrecord.io.dataset import TFRecordDataset
+        from tpu_tfrecord.options import TFRecordOptions
+
+        base: Dict[str, Any] = {
+            "record_type": spec["record_type"],
+            "verify_crc": spec["verify_crc"],
+            "schema": spec["schema"],
+            "on_corrupt": spec["on_corrupt"],
+            "max_corrupt_records": spec["max_corrupt_records"],
+            "corrupt_fallback": spec["corrupt_fallback"],
+            "on_stall": spec["on_stall"],
+        }
+        wo = self._options
+        if wo is not None:
+            base.update(
+                cache=wo.cache,
+                cache_dir=wo.cache_dir,
+                cache_max_bytes=wo.cache_max_bytes,
+                trace=wo.trace,
+                read_deadline_ms=wo.read_deadline_ms,
+                open_deadline_ms=wo.open_deadline_ms,
+                hedge_after_ms=wo.hedge_after_ms,
+                watchdog_timeout_ms=wo.watchdog_timeout_ms,
+            )
+        ds = TFRecordDataset(
+            spec["paths"],
+            batch_size=int(spec["batch_size"]),
+            options=TFRecordOptions.from_map(base),
+            columns=list(spec["columns"]),
+            num_epochs=1,
+            process_index=0,
+            process_count=1,
+            num_workers=1,
+            hash_buckets=spec.get("hash_buckets") or None,
+            pack=spec.get("pack") or None,
+            slab_bytes=int(spec["slab_bytes"]),
+            max_record_bytes=int(spec["max_record_bytes"]),
+        )
+        mine = shards_digest(ds._reader.shards)
+        if mine != spec["shards_digest"]:
+            raise ServiceSpecError(
+                f"shard list diverged: worker sees digest {mine}, consumer "
+                f"sent {spec['shards_digest']} — the dataset changed under "
+                "the service"
+            )
+        want_fused = spec.get("fused")
+        have_fused = ds._native_decoder is not None
+        if want_fused is not None and bool(want_fused) != have_fused:
+            raise ServiceSpecError(
+                f"fused-decode availability diverged (consumer "
+                f"fused={want_fused}, worker fused={have_fused}): chunks "
+                "would carry different columns"
+            )
+        idx_of = {sh.path: i for i, sh in enumerate(ds.shards)}
+        with self._ds_lock:
+            self._datasets[digest] = (ds, idx_of)
+            # LRU-ish cap: a long-lived worker serving a succession of
+            # distinct jobs must not grow without bound (each entry holds
+            # decoder state, shard lists, and IO scratch); insertion order
+            # approximates recency well enough here because a job's
+            # fetches arrive in bursts.
+            while len(self._datasets) > MAX_CACHED_JOBS:
+                evicted = next(iter(self._datasets))
+                if evicted == digest:
+                    break
+                del self._datasets[evicted]
+        return ds, idx_of
+
+    def _handle_fetch(
+        self, conn: socket.socket, msg: Dict[str, Any], peer: str
+    ) -> bool:
+        """Stream one shard from ``skip``; returns False when the
+        connection is no longer usable for further requests."""
+        try:
+            spec = msg["spec"]
+            shard_path = str(msg["shard"])
+            skip = int(msg.get("skip", 0))
+        except (KeyError, TypeError, ValueError) as e:
+            sp.send_msg(conn, {"op": "error", "kind": "bad_request",
+                               "error": f"malformed fetch: {e!r}"})
+            return True
+        # Liveness vs construction: the first fetch of a job pays seconds
+        # of dataset construction on a loaded box, so build on the side
+        # and stream `building` keepalives — the consumer's per-op recv
+        # deadline then measures LIVENESS, and a cold healthy worker is
+        # never mistaken for a dead one (a deadline miss here used to add
+        # a spurious lease reassignment per cold worker).
+        built: Dict[str, Any] = {}
+        done = threading.Event()
+
+        def _build() -> None:
+            try:
+                built["ds"] = self._dataset_for(spec)
+            except BaseException as e:
+                built["err"] = e
+            finally:
+                done.set()
+
+        threading.Thread(target=_build, daemon=True).start()
+        try:
+            while not done.wait(0.25):
+                sp.send_msg(conn, {"op": "building"})
+        except OSError:
+            return False  # consumer went away mid-construction
+        err = built.get("err")
+        if err is not None:
+            if isinstance(err, ServiceSpecError):
+                kind = "spec_mismatch"
+            elif isinstance(err, (KeyError, TypeError, ValueError)):
+                kind = "bad_request"
+            else:  # dataset construction (bad paths, IO)
+                kind = "io"
+            sp.send_msg(conn, {"op": "error", "kind": kind, "error": str(err)})
+            return True
+        ds, idx_of = built["ds"]
+        try:
+            idx = idx_of[shard_path]
+        except KeyError:
+            sp.send_msg(conn, {"op": "error", "kind": "bad_request",
+                               "error": f"unknown shard {shard_path!r}"})
+            return True
+        METRICS.count("service.fetches")
+        k = 0
+        try:
+            with telemetry.span("service.serve", shard=shard_path) as span:
+                for chunk, _e, _p, start in ds._decode_shard(0, 0, idx, skip):
+                    nbytes = sp.send_chunk(conn, chunk, start, k)
+                    k += 1
+                    METRICS.count("service.chunks_sent")
+                    METRICS.count("service.bytes_sent", nbytes)
+                span.set(chunks=k)
+            sp.send_msg(conn, {"op": "eof", "chunks": k})
+            METRICS.count("service.shards_served")
+            return True
+        except wire.TFRecordCorruptionError as e:
+            try:
+                sp.send_msg(conn, {"op": "error", "kind": "corruption",
+                                   "error": str(e)})
+            except OSError:
+                pass
+            return False
+        except (OSError, sp.ProtocolError) as e:
+            # consumer vanished mid-stream, or the worker's own read
+            # failed: if the pipe still works, tell the consumer so it can
+            # try another worker rather than waiting out its deadline
+            try:
+                sp.send_msg(conn, {"op": "error", "kind": "io",
+                                   "error": str(e)})
+            except OSError:
+                pass
+            return False
+
+
+# ---------------------------------------------------------------------------
+# Consumer client
+# ---------------------------------------------------------------------------
+
+
+class ServiceClient:
+    """The consumer side: an alternative chunk source for one iterator.
+    ``shard_chunks`` yields the exact ``(chunk, epoch, pos, start)`` tuples
+    ``TFRecordDataset._chunk_stream`` would have decoded locally, fetched
+    from leased workers instead — with reconnect-and-dedupe on worker
+    death, dispatcher-outage backoff, and the local-read fallback."""
+
+    def __init__(self, ds):
+        opts = ds.options
+        self._ds = ds
+        self.addr = opts.service
+        self.deadline_s = (opts.service_deadline_ms or 5000.0) / 1000.0
+        fb = opts.service_fallback_ms
+        self.fallback_s = fb / 1000.0 if fb is not None else None
+        self._clock = ds.retry_policy.clock
+        self._sleep = ds.retry_policy.sleep
+        self._spec = build_job_spec(ds)
+        self._job = job_digest(self._spec)
+        self._dtype_of = ds.chunk_dtypes().__getitem__
+        self._verify = opts.verify_crc
+        self._global_index = {
+            sh.path: i for i, sh in enumerate(ds._reader.shards)
+        }
+        self._disp: Optional[socket.socket] = None
+        self._degraded = False
+        # Worker ids this client WATCHED fail (wid -> suspected-at time),
+        # remembered across shards: until the dispatcher expires the dead
+        # worker's heartbeat (one lease TTL), routing would otherwise hand
+        # every subsequent shard to the corpse first — one connect-fail
+        # and one spurious lease_reassignment per shard. Suspicion is
+        # client-scoped and self-healing three ways: the dispatcher
+        # ignores exclusions that would leave no candidates, a suspect
+        # that completes a shard for us is cleared, and suspicion ages out
+        # after one lease TTL (by then the dispatcher's own heartbeat
+        # accounting has caught a genuinely dead worker — one transient
+        # hiccup must not exile a healthy worker for the client's life).
+        self._suspects: Dict[str, float] = {}
+        self._suspect_ttl_s = opts.service_lease_ttl_s
+
+    def close(self) -> None:
+        if self._disp is not None:
+            try:
+                self._disp.close()
+            except OSError:
+                pass
+            self._disp = None
+
+    def _dispatcher_rpc(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        if self._disp is None:
+            s = sp.connect(self.addr, timeout=self.deadline_s)
+            s.settimeout(self.deadline_s)
+            self._disp = s
+        try:
+            return sp.request(self._disp, self.addr, obj)
+        except (OSError, sp.ProtocolError):
+            self.close()
+            raise
+
+    def _live_suspects(self) -> List[str]:
+        now = self._clock()
+        for wid in [w for w, t in self._suspects.items()
+                    if now - t >= self._suspect_ttl_s]:
+            del self._suspects[wid]
+        return list(self._suspects)
+
+    def _shard_done(self, worker_id: str, shard_path: str) -> None:
+        try:
+            self._dispatcher_rpc(
+                {"op": "shard_done", "proto": PROTO_VERSION, "job": self._job,
+                 "path": shard_path, "worker_id": worker_id}
+            )
+        except (OSError, sp.ProtocolError):
+            pass  # accounting only — the consumer's own position is truth
+
+    def shard_chunks(self, epoch: int, pos: int, shard_idx: int, skip: int, stop):
+        """Yield one shard's chunk tuples from the resume point, exactly
+        once: ``consumed`` tracks the absolute record offset acked into
+        the pipeline; every retry re-requests FROM that offset and any
+        redelivered prefix is dropped/sliced, so a worker death, a
+        dispatcher restart, or a reconnect can duplicate nothing and skip
+        nothing."""
+        ds = self._ds
+        shard = ds.shards[shard_idx]
+        consumed = skip
+        exclude: List[str] = self._live_suspects()
+        budget_start = self._clock()
+        attempt = 0
+        while not stop.is_set():
+            wid = None
+            try:
+                reply = self._dispatcher_rpc(
+                    {
+                        "op": "route",
+                        "proto": PROTO_VERSION,
+                        "job": self._job,
+                        "path": shard.path,
+                        "shard_index": self._global_index[shard.path],
+                        "exclude": exclude,
+                    }
+                )
+                if reply.get("error"):
+                    raise ServiceUnavailable(str(reply["error"]))
+                worker_addr, wid = str(reply["worker"]), str(reply["worker_id"])
+                ttl = reply.get("lease_ttl_s")
+                if ttl is not None:
+                    self._suspect_ttl_s = float(ttl)
+                for item in self._fetch_shard(
+                    worker_addr, shard.path, consumed, epoch, pos, stop
+                ):
+                    yield item
+                    consumed = item[3] + item[0].num_rows
+                    budget_start = self._clock()  # progress resets the budget
+                    exclude = self._live_suspects()
+                    attempt = 0
+                # a suspect that just completed a shard for us is healthy
+                self._suspects.pop(wid, None)
+                self._shard_done(wid, shard.path)
+                self._degraded = False
+                return
+            except ServiceSpecError:
+                raise
+            except wire.TFRecordCorruptionError:
+                raise  # same outcome a local strict read would have had
+            except (OSError, sp.ProtocolError, ServiceUnavailable) as e:
+                METRICS.count("service.reconnects")
+                if wid is not None and wid not in exclude:
+                    exclude.append(wid)
+                if wid is not None:
+                    self._suspects[wid] = self._clock()
+                attempt += 1
+                now = self._clock()
+                exhausted = (
+                    self.fallback_s is not None
+                    and now - budget_start >= self.fallback_s
+                )
+                if exhausted or self._degraded:
+                    self._fallback(shard.path, e)
+                    yield from ds._decode_shard(epoch, pos, shard_idx, consumed)
+                    return
+                # the policy owns backoff shape (capped exponential, full
+                # jitter — M consumers losing the same worker must not
+                # retry the dispatcher in lockstep), and the sleep never
+                # overruns the remaining fallback budget
+                delay = ds.retry_policy.backoff(min(attempt, 16))
+                if self.fallback_s is not None:
+                    delay = min(
+                        delay, max(0.0, self.fallback_s - (now - budget_start))
+                    )
+                self._sleep(delay)
+
+    def _fetch_shard(self, worker_addr, shard_path, skip, epoch, pos, stop):
+        sock = sp.connect(worker_addr, timeout=self.deadline_s)
+        try:
+            sock.settimeout(self.deadline_s)
+            sp.send_msg(
+                sock,
+                {"op": "fetch", "proto": PROTO_VERSION, "spec": self._spec,
+                 "shard": shard_path, "skip": skip},
+            )
+            consumed = skip
+            while not stop.is_set():
+                # EOF here (allow_eof=False) raises ProtocolError: a worker
+                # that closes mid-shard without an `eof` message is a death
+                msg = sp.recv_msg(sock, worker_addr)
+                op = msg.get("op")
+                if op == "chunk":
+                    chunk = sp.recv_chunk_body(
+                        sock, msg, worker_addr, self._dtype_of, self._verify
+                    )
+                    start = int(msg["start"])
+                    rows = chunk.num_rows
+                    METRICS.count("service.chunks_recv")
+                    if rows == 0 or start + rows <= consumed:
+                        METRICS.count("service.redelivered_dropped")
+                        continue
+                    if start < consumed:
+                        # partial overlap with already-acked rows: keep
+                        # only the unseen suffix
+                        METRICS.count("service.redelivered_dropped")
+                        chunk = slice_batch(chunk, consumed - start, rows)
+                        start = consumed
+                    yield chunk, epoch, pos, start
+                    consumed = start + chunk.num_rows
+                elif op == "building":
+                    continue  # keepalive: the worker is constructing its
+                    # dataset — alive, just not streaming yet
+                elif op == "eof":
+                    return
+                elif op == "error":
+                    kind = msg.get("kind")
+                    err = str(msg.get("error", "worker error"))
+                    if kind == "corruption":
+                        raise wire.TFRecordCorruptionError(err)
+                    if kind == "spec_mismatch":
+                        raise ServiceSpecError(err)
+                    raise ServiceUnavailable(f"{worker_addr}: {err}")
+                else:
+                    raise sp.ProtocolError(
+                        f"unexpected message {op!r} from {worker_addr}"
+                    )
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _fallback(self, shard_path: str, err: BaseException) -> None:
+        self._degraded = True
+        METRICS.count("service.fallbacks")
+        telemetry.instant("service.fallback", shard=shard_path, error=str(err))
+        log_salvage_event(
+            path=shard_path, kind="service_fallback", error=str(err)
+        )
+
+
+def fetch_status(addr: str, timeout: float = 5.0) -> Dict[str, Any]:
+    """One status round trip to a dispatcher (the ``serve-status`` doctor
+    subcommand's transport)."""
+    sock = sp.connect(addr, timeout=timeout)
+    try:
+        sock.settimeout(timeout)
+        return sp.request(
+            sock, addr, {"op": "status", "proto": PROTO_VERSION}
+        )
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# CLI — `python -m tpu_tfrecord.service dispatcher|worker`
+# ---------------------------------------------------------------------------
+
+
+def _run_forever(stop_event: threading.Event) -> None:
+    import signal
+
+    def _term(_sig, _frm):
+        raise SystemExit(0)
+
+    try:
+        signal.signal(signal.SIGTERM, _term)
+    except ValueError:
+        pass  # not the main thread (tests drive main() directly)
+    try:
+        while not stop_event.is_set():
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+
+
+def _spool_args(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument("--spool-dir", default=None,
+                    help="telemetry spool directory (tfrecord_doctor fleet)")
+    ap.add_argument("--spool-interval", type=float, default=1.0)
+
+
+def _maybe_spool(args, role: str):
+    if args.spool_dir is None:
+        return None
+    from tpu_tfrecord import fleet
+
+    fleet.acquire_spool(args.spool_dir, role=role, interval_s=args.spool_interval)
+    return args.spool_dir
+
+
+def dispatcher_main(argv: List[str]) -> int:
+    from tpu_tfrecord.options import TFRecordOptions
+
+    ap = argparse.ArgumentParser(prog="tpu_tfrecord.service dispatcher")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--journal", default=None,
+                    help="assignment journal path (atomic rewrite; a "
+                    "restarted dispatcher replays it)")
+    ap.add_argument("--lease-ttl-s", type=float,
+                    default=TFRecordOptions().service_lease_ttl_s)
+    _spool_args(ap)
+    args = ap.parse_args(argv)
+    telemetry.adopt_from_env(role="dispatcher")
+    d = ServiceDispatcher(
+        port=args.port, host=args.host, journal=args.journal,
+        lease_ttl_s=args.lease_ttl_s,
+    ).start()
+    spool = _maybe_spool(args, "dispatcher")
+    print(json.dumps({"event": "ready", "role": "dispatcher",
+                      "addr": d.addr, "pid": os.getpid()}), flush=True)
+    try:
+        _run_forever(d._stop)
+    finally:
+        d.stop()
+        if spool is not None:
+            from tpu_tfrecord import fleet
+
+            fleet.release_spool(spool)
+    return 0
+
+
+def worker_main(argv: List[str]) -> int:
+    from tpu_tfrecord.options import TFRecordOptions
+
+    ap = argparse.ArgumentParser(prog="tpu_tfrecord.service worker")
+    ap.add_argument("--dispatcher", required=True, help="dispatcher host:port")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--worker-id", default=None)
+    ap.add_argument("--role", default="decode_worker")
+    ap.add_argument("--cache", default="off", choices=("off", "auto"),
+                    help="columnar epoch cache mode for this worker")
+    ap.add_argument("--cache-dir", default=None)
+    ap.add_argument("--cache-max-bytes", type=int, default=None)
+    _spool_args(ap)
+    args = ap.parse_args(argv)
+    telemetry.adopt_from_env(role=args.role)
+    opts = TFRecordOptions.from_map(
+        cache=args.cache, cache_dir=args.cache_dir,
+        cache_max_bytes=args.cache_max_bytes,
+    )
+    w = DecodeWorker(
+        args.dispatcher, options=opts, port=args.port, host=args.host,
+        worker_id=args.worker_id, role=args.role,
+    ).start()
+    spool = _maybe_spool(args, args.role)
+    print(json.dumps({"event": "ready", "role": args.role, "addr": w.addr,
+                      "worker_id": w.worker_id, "pid": os.getpid()}),
+          flush=True)
+    try:
+        _run_forever(w._stop)
+    finally:
+        w.stop()
+        if spool is not None:
+            from tpu_tfrecord import fleet
+
+            fleet.release_spool(spool)
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "dispatcher":
+        return dispatcher_main(argv[1:])
+    if argv and argv[0] == "worker":
+        return worker_main(argv[1:])
+    sys.stderr.write(
+        "usage: python -m tpu_tfrecord.service {dispatcher|worker} [options]\n"
+    )
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -347,6 +347,26 @@ def adopt(ctx: TraceContext) -> TraceContext:
     return RECORDER.adopt(ctx)
 
 
+def _adopt_child_of(obj: Any, role: Optional[str]) -> TraceContext:
+    """Adopt a context that joins the trace ``obj`` (a parsed TraceContext
+    JSON object) describes: keep the parent's trace id, record the
+    parent's span as our parent, mint our own span id (host/pid stamped by
+    ``adopt``). Raises on malformed payloads — callers own the degrade
+    policy."""
+    if not isinstance(obj, dict):
+        # valid JSON that is not an object ('null', '[1]', '"x"')
+        # is just as malformed as unparseable bytes
+        raise ValueError(f"not a JSON object: {obj!r}")
+    parent = TraceContext.from_json(obj)
+    ctx = TraceContext(
+        trace_id=parent.trace_id,
+        span_id=_new_id(),
+        parent_span_id=parent.span_id,
+        role=role if role is not None else parent.role,
+    )
+    return RECORDER.adopt(ctx)
+
+
 def adopt_from_env(
     role: Optional[str] = None, environ: Optional[Dict[str, str]] = None
 ) -> TraceContext:
@@ -359,22 +379,26 @@ def adopt_from_env(
     raw = environ.get(TRACE_CONTEXT_ENV)
     if raw:
         try:
-            obj = json.loads(raw)
-            if not isinstance(obj, dict):
-                # valid JSON that is not an object ('null', '[1]', '"x"')
-                # is just as malformed as unparseable bytes
-                raise ValueError(f"not a JSON object: {obj!r}")
-            parent = TraceContext.from_json(obj)
-            ctx = TraceContext(
-                trace_id=parent.trace_id,
-                span_id=_new_id(),
-                parent_span_id=parent.span_id,
-                role=role if role is not None else parent.role,
-            )
-            return RECORDER.adopt(ctx)
+            return _adopt_child_of(json.loads(raw), role)
         except (ValueError, TypeError, KeyError, AttributeError):
             pass  # a malformed payload must not take the pipeline down
     return RECORDER.adopt(TraceContext.new(role if role is not None else "main"))
+
+
+def adopt_child_from_json(
+    obj: Any, role: Optional[str] = None
+) -> TraceContext:
+    """Join the trace of a coordinator that handed us its context over a
+    WIRE payload rather than a spawn environment — the data-service worker
+    adopting the dispatcher's trace at registration. Same semantics as
+    ``adopt_from_env`` (ids propagate, identities never do); a malformed
+    payload degrades to a fresh root, never raises."""
+    try:
+        return _adopt_child_of(obj, role)
+    except (ValueError, TypeError, KeyError, AttributeError):
+        return RECORDER.adopt(
+            TraceContext.new(role if role is not None else "main")
+        )
 
 
 def atomic_write_bytes(path: str, data: bytes) -> None:

@@ -188,6 +188,20 @@ def adopt_shared_trace_context(role: str = "worker"):
     return telemetry.adopt(ctx)
 
 
+def shared_service_address(addr: str) -> str:
+    """Validate that every host of a multihost run points its consumers at
+    the SAME data-service dispatcher before any bytes flow (rides the
+    existing allgather). Two hosts talking to two dispatchers would each
+    get self-consistent but differently-leased epochs — the classic
+    silently-diverged-fleet failure this module's consistency checks
+    exist for. Returns ``addr`` so call sites can inline it:
+    ``options = {..., "service": shared_service_address(addr)}``."""
+    assert_same_across_hosts(
+        str(addr).encode("utf-8"), "data-service dispatcher address"
+    )
+    return str(addr)
+
+
 def assert_same_across_hosts(value: bytes, what: str = "value") -> None:
     """Cheap cross-host consistency check (e.g. schema JSON, shard-list
     digest) — catches divergent host state before it corrupts a run."""

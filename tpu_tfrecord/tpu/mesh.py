@@ -75,6 +75,8 @@ def assign_shards(
     analog of Spark's task placement, but static and reproducible so
     checkpoint/resume and multi-host runs agree without coordination.
     """
+    from tpu_tfrecord.io.paths import interleave
+
     pi = jax.process_index() if process_index is None else process_index
     pc = jax.process_count() if process_count is None else process_count
-    return [sh for i, sh in enumerate(shards) if i % pc == pi]
+    return interleave(shards, pi, pc)
