@@ -1,0 +1,44 @@
+"""Subprocess worker for the kill -9 mid-populate test
+(tests/test_http_remote.py): stream a remote HTTP dataset with the
+columnar epoch cache populating, printing one line per batch so the
+parent can SIGKILL this process while a cache entry is mid-append.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("url")
+    ap.add_argument("cache_dir")
+    ap.add_argument("--batch-size", type=int, default=256)
+    args = ap.parse_args()
+
+    from tpu_tfrecord.io.dataset import TFRecordDataset
+    from tpu_tfrecord.schema import (
+        LongType, StringType, StructField, StructType,
+    )
+
+    schema = StructType([
+        StructField("id", LongType(), nullable=False),
+        StructField("s", StringType()),
+    ])
+    ds = TFRecordDataset(
+        args.url, batch_size=args.batch_size, schema=schema,
+        drop_remainder=False, cache="auto", cache_dir=args.cache_dir,
+    )
+    n = 0
+    with ds.batches() as it:
+        for cb in it:
+            n += cb.num_rows
+            print(f"batch rows={n}", flush=True)
+    print(f"done rows={n}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

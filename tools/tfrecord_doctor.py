@@ -182,10 +182,20 @@ def doctor_file(
 
 
 def expand_paths(inputs: List[str]) -> List[str]:
-    """Files pass through; directories/globs expand to their data shards."""
+    """Files pass through; directories/globs expand to their data shards.
+    Scheme'd sources (``http(s)://``, ``gs://``, ...) resolve through the
+    pluggable FS layer, so ``tfrecord_doctor scan`` reads remote shards
+    over the same connectors the pipeline uses."""
+    from tpu_tfrecord import fs as _fs
+
     out: List[str] = []
     for item in inputs:
-        if os.path.isfile(item):
+        if _fs.has_scheme(item):
+            if _fs.filesystem_for(item).isfile(item):
+                out.append(item)
+            else:
+                out.extend(sh.path for sh in discover_shards(item))
+        elif os.path.isfile(item):
             out.append(item)
         else:
             out.extend(sh.path for sh in discover_shards(item))
@@ -782,6 +792,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                 return 2
             if args.out is not None and len(files) != 1:
                 ap.error("--out requires exactly one input file")
+            if args.repair and args.out is None:
+                from tpu_tfrecord import fs as _fs
+
+                remote = [p for p in files if _fs.has_scheme(p)]
+                if remote:
+                    ap.error(
+                        "--repair of a remote source needs an explicit "
+                        f"LOCAL --out (cannot write next to {remote[0]})"
+                    )
             rc = 0
             for path in files:
                 try:

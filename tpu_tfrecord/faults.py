@@ -58,6 +58,14 @@ FAULT_KINDS = (
     "rename_race",
     "flaky_listing",
     "disconnect",
+    # HTTP-request kinds (op="http"), executed by the fault-injecting
+    # Range server (tpu_tfrecord.httpfs.serve_directory) — faults that
+    # fire at the REAL socket level, not inside a wrapped file object:
+    "reset",  # RST the connection mid-body (SO_LINGER 0 + close)
+    "truncated_body",  # full Content-Length declared, fewer bytes sent
+    "http_error",  # `status` (503/429/...) response, Retry-After honored
+    "bad_content_range",  # serve range start+shift_bytes, honestly labeled
+    "trickle",  # body dribbled cap_bytes per stall_ms — slow-trickle stall
 )
 
 #: ops a rule may target. ``read`` covers read()/readinto() on handles the
@@ -71,8 +79,29 @@ FAULT_KINDS = (
 #: partial-segment scenario every recv loop must refill past), and
 #: ``disconnect`` closes the socket mid-frame — the short-frame scenario
 #: the protocol must convert into a loud ProtocolError, never into
-#: truncated data.
-FAULT_OPS = ("open", "read", "rename", "listdir", "connect", "recv")
+#: truncated data. ``http`` is the request seam of the real-network
+#: remote tier (tpu_tfrecord.httpfs): the path a rule matches is
+#: ``<url path>@<range start>`` — keyed per byte offset so retries of the
+#: same block get deterministic ordinals even with concurrent fetches —
+#: and the HTTP-specific kinds above fire on the server's side of a real
+#: TCP connection. ``connect`` rules also apply to the HTTP client's
+#: connection establishment (peer "host:port"): a transient/permanent
+#: error there IS connection-refused as the client observes it.
+FAULT_OPS = ("open", "read", "rename", "listdir", "connect", "recv", "http")
+
+#: kinds only the fault-injecting HTTP server executes (op="http").
+HTTP_ONLY_KINDS = (
+    "reset", "truncated_body", "http_error", "bad_content_range", "trickle",
+)
+
+#: every kind an ``op="http"`` rule may carry — the HTTP-only kinds plus
+#: the generic ones the Range server's dispatch actually executes. A kind
+#: outside this set on op="http" (short_read, disconnect, ...) would be
+#: LEDGERED as fired while the server serves the object clean — the
+#: silent-no-op this vocabulary check exists to refuse.
+HTTP_ALLOWED_KINDS = HTTP_ONLY_KINDS + (
+    "stall", "transient_error", "permanent_error",
+)
 
 
 class InjectedFault(OSError):
@@ -97,6 +126,9 @@ class FaultRule:
     cap_bytes: int = 0
     probability: float = 1.0
     error: str = ""
+    status: int = 503  # http_error response code (429/503/...)
+    retry_after_s: float = 0.0  # Retry-After header on http_error responses
+    shift_bytes: int = 64  # bad_content_range: how far the server lies
 
     def __post_init__(self) -> None:
         if self.op not in FAULT_OPS:
@@ -115,8 +147,22 @@ class FaultRule:
             # cap 0 would make read() return b"" — indistinguishable from
             # EOF, i.e. silent truncation instead of a short read
             raise ValueError("short_read requires cap_bytes >= 1")
-        if self.kind == "stall" and self.stall_ms <= 0:
-            raise ValueError("stall requires stall_ms > 0")
+        if self.kind in ("stall", "trickle") and self.stall_ms <= 0:
+            raise ValueError(f"{self.kind} requires stall_ms > 0")
+        if self.kind in HTTP_ONLY_KINDS and self.op != "http":
+            # these describe server-side wire behavior; a rule asking a
+            # file wrapper to RST a connection would silently no-op
+            raise ValueError(f"kind {self.kind!r} requires op='http'")
+        if self.op == "http" and self.kind not in HTTP_ALLOWED_KINDS:
+            raise ValueError(
+                f"op='http' supports kinds {HTTP_ALLOWED_KINDS}, got "
+                f"{self.kind!r} — the Range server would ledger it as "
+                "fired while serving the object clean"
+            )
+        if self.kind == "http_error" and not 400 <= self.status <= 599:
+            raise ValueError("http_error requires a 4xx/5xx status")
+        if self.kind == "bad_content_range" and self.shift_bytes == 0:
+            raise ValueError("bad_content_range requires shift_bytes != 0")
 
     def matches_path(self, path: str) -> bool:
         return self.path in path
@@ -206,10 +252,14 @@ class FaultPlan:
                     "ordinal": n,
                     "kind": rule.kind,
                 }
-                if rule.kind == "stall":
+                if rule.kind in ("stall", "trickle"):
                     entry["stall_ms"] = rule.stall_ms
                 if rule.kind == "short_read":
                     entry["cap_bytes"] = rule.cap_bytes
+                if rule.kind == "http_error":
+                    entry["status"] = rule.status
+                if rule.kind == "bad_content_range":
+                    entry["shift_bytes"] = rule.shift_bytes
                 self.ledger.append(entry)
                 fired.append(dict(entry, _rule=rule))
         return fired
@@ -383,6 +433,7 @@ def install_chaos(plan: FaultPlan):
     ``recv`` rules. Restores everything on exit and releases any
     in-flight default-sleep stalls."""
     from tpu_tfrecord import fs as _fs
+    from tpu_tfrecord import httpfs as _httpfs
     from tpu_tfrecord import service_protocol as _sp
     from tpu_tfrecord.io import dataset as _dataset
 
@@ -390,6 +441,7 @@ def install_chaos(plan: FaultPlan):
     orig_local_open = _fs.local_open
     orig_open_local = _dataset._open_local
     orig_chaos_plan = _sp._CHAOS_PLAN
+    orig_http_plan = _httpfs._CHAOS_PLAN
 
     def chaos_filesystem_for(path: str):
         return ChaosFS(orig_filesystem_for(path), plan)
@@ -403,9 +455,12 @@ def install_chaos(plan: FaultPlan):
     _fs.filesystem_for = chaos_filesystem_for
     _fs.local_open = chaos_local_open
     _dataset._open_local = chaos_local_open
-    # the socket seam: service_protocol consults this plan at every
-    # connect and recv for the duration of the block
+    # the socket seams: service_protocol consults this plan at every
+    # connect and recv, and the HTTP remote client (httpfs) at every
+    # connection establishment — a ``connect`` transient/permanent rule
+    # there is connection-refused exactly as the client observes it
     _sp._CHAOS_PLAN = plan
+    _httpfs._CHAOS_PLAN = plan
     try:
         yield plan
     finally:
@@ -413,4 +468,5 @@ def install_chaos(plan: FaultPlan):
         _fs.local_open = orig_local_open
         _dataset._open_local = orig_open_local
         _sp._CHAOS_PLAN = orig_chaos_plan
+        _httpfs._CHAOS_PLAN = orig_http_plan
         plan.release()

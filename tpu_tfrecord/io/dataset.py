@@ -303,6 +303,10 @@ class TFRecordDataset:
             # reads unwrapped) gives the controller a place to install
             # them; streams opened after an install are guarded
             self._stall_guard = StallGuard()
+        if self._stall_guard is not None:
+            # remote block fetches (PrefetchReader) self-heal under the
+            # SAME budget as the shard-level retries
+            self._stall_guard.retry_policy = self.retry_policy
         # Sliding posix_fadvise(WILLNEED) window for local shards (0 = off):
         # the kernel fetches ahead ASYNCHRONOUSLY while the C++ decoder
         # chews the current chunk, so cold (non-page-cache-resident) reads
@@ -414,11 +418,27 @@ class TFRecordDataset:
 
     def _guarded_open_fn(self):
         """The (path, codec) opener the span streams use: the stall guard's
-        deadline/hedge open when configured, None (= plain
-        wire.open_compressed) otherwise."""
+        deadline/hedge open when configured, otherwise a plain
+        wire.open_compressed that carries this dataset's retry policy to
+        the remote block prefetcher (so PrefetchReader fetches self-heal
+        from the exact byte offset under the same budget the shard-level
+        retries use)."""
         if self._stall_guard is not None:
             return self._stall_guard.open_compressed
-        return None
+        pol = self.retry_policy
+
+        def open_fn(path, codec):
+            from tpu_tfrecord import fs as _fs
+
+            # local paths keep the exact legacy call shape (tests stub
+            # wire.open_compressed with 3-arg fakes; the policy only
+            # matters for the remote block prefetcher anyway)
+            if _fs.has_scheme(path):
+                return wire.open_compressed(path, "rb", codec,
+                                            retry_policy=pol)
+            return wire.open_compressed(path, "rb", codec)
+
+        return open_fn
 
     def epoch_order(self, epoch: int) -> List[int]:
         """Iteration order over this host's shard list for one epoch.
@@ -817,9 +837,7 @@ class TFRecordDataset:
         verify = self.options.verify_crc
         scratch = self._io_scratch()
 
-        open_fn = self._guarded_open_fn() or (
-            lambda p, c: wire.open_compressed(p, "rb", c)
-        )
+        open_fn = self._guarded_open_fn()
 
         def attempt() -> Iterator[tuple]:
             with _timed_open(open_fn, shard.path, codec) as fh:

@@ -75,7 +75,19 @@ def param_shardings(mesh: Mesh, expert_axis: str = "model") -> Dict[str, Any]:
 
 def _capacity(tokens: int, cfg: MoEConfig) -> int:
     # ceil, per the config contract: factor 1.0 must JUST FIT perfectly
-    # balanced routing (floor would drop tokens even when balanced)
+    # balanced routing (floor would drop tokens even when balanced).
+    #
+    # ``tokens`` is the STATIC flattened count INCLUDING padding, even
+    # when ``moe_apply`` is given a ``valid`` mask (ADVICE r5 #3 — a
+    # deliberate choice, documented here): capacity must be a
+    # compile-time constant for the static-shape dispatch/combine
+    # einsums, and the valid-token count is a runtime value. The effect
+    # is CONSERVATIVE relative to the Switch formulation on heavily
+    # padded batches — effective capacity_factor over valid tokens is
+    # inflated, so FEWER tokens drop than factor implies, at the cost of
+    # dispatch/combine tensors sized for the padded length. Callers
+    # wanting a tighter match can shrink capacity_factor by their static
+    # worst-case valid fraction.
     cap = -(-int(tokens * cfg.capacity_factor) // cfg.n_experts)
     return max(1, cap)
 

@@ -412,12 +412,17 @@ class StallGuard:
         hedge_after: Optional[float] = None,
         metrics: Metrics = METRICS,
         io_chunk: int = 4 << 20,
+        retry_policy=None,
     ):
         self.read_deadline = read_deadline
         self.open_deadline = open_deadline
         self.hedge_after = hedge_after
         self.metrics = metrics
         self.io_chunk = io_chunk
+        # handed to the remote block prefetcher so its fetches self-heal
+        # under the SAME budget the dataset's shard-level retries use
+        # (io/dataset sets this from its retry_policy)
+        self.retry_policy = retry_policy
         # the process-wide pool: shard churn reuses worker threads instead
         # of creating one per open, and discarding this guard strands no
         # idle threads (ShardReader builds a guard per shard)
@@ -486,7 +491,12 @@ class StallGuard:
 
         if _fs.has_scheme(path):
             fsys = _fs.filesystem_for(path)
-            raw = self.call_open(lambda: _fs.open_for_read(fsys, path), path)
+            raw = self.call_open(
+                lambda: _fs.open_for_read(
+                    fsys, path, retry_policy=self.retry_policy
+                ),
+                path,
+            )
 
             def reopen(pos: int) -> BinaryIO:
                 fh = fsys.open(path, "rb")
@@ -519,23 +529,12 @@ class StallGuard:
 
 
 def _seek_to(fh, pos: int) -> None:
-    """Position a fresh hedge handle at ``pos``: seek when supported,
-    read-and-discard otherwise (non-seekable remote wrappers)."""
-    if pos <= 0:
-        return
-    seek = getattr(fh, "seek", None)
-    if seek is not None:
-        try:
-            seek(pos)
-            return
-        except (OSError, ValueError):
-            pass
-    left = pos
-    while left > 0:
-        chunk = fh.read(min(left, 8 << 20))
-        if not chunk:
-            return
-        left -= len(chunk)
+    """Position a fresh hedge handle at ``pos`` — the shared
+    seek-or-discard idiom lives in fs.seek_to (one owner with the
+    self-healing stream's resume)."""
+    from tpu_tfrecord.fs import seek_to
+
+    seek_to(fh, pos)
 
 
 def guard_from_options(options) -> Optional[StallGuard]:

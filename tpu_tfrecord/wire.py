@@ -265,13 +265,17 @@ def codec_from_path(path: str) -> Optional[str]:
     return None
 
 
-def open_compressed(path: str, mode: str, codec: Optional[str]) -> BinaryIO:
+def open_compressed(
+    path: str, mode: str, codec: Optional[str], retry_policy=None
+) -> BinaryIO:
     """Open a (possibly compressed) record stream. Paths with a URL scheme
     route through the pluggable filesystem layer (tpu_tfrecord.fs — the
     reference's Hadoop FileSystem + CodecStreams equivalent,
     TFRecordOutputWriter.scala:19); the codec wraps the raw stream either
     way. Plain paths open through ``fs.local_open`` — the raw-open seam
-    the chaos injector (tpu_tfrecord.faults) patches."""
+    the chaos injector (tpu_tfrecord.faults) patches. ``retry_policy``
+    reaches the remote block prefetcher: transient fetch faults self-heal
+    from the exact byte offset instead of failing the whole stream."""
     codec = normalize_codec(codec)
     from tpu_tfrecord import fs as _fs
 
@@ -280,7 +284,7 @@ def open_compressed(path: str, mode: str, codec: Optional[str]) -> BinaryIO:
         if mode in ("rb", "r"):
             # block-pipelined readahead for big remote objects (the Hadoop
             # FS connector streaming the reference gets for free — L6)
-            raw = _fs.open_for_read(fsys, path)
+            raw = _fs.open_for_read(fsys, path, retry_policy=retry_policy)
         else:
             raw = fsys.open(path, mode)
     else:
