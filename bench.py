@@ -1019,6 +1019,145 @@ def _service_probe(data_dir, schema, hash_buckets, pack) -> dict:
         d.stop()
 
 
+def _model_parallel_child() -> None:
+    """Subprocess body (CPU 8-device env forced by the parent): measure the
+    model-parallel memory shape + a causal-LM train rate, print ONE JSON
+    line. Device-free from the PARENT's point of view — the ambient
+    backend (and any dead TPU tunnel) is never touched."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import optax
+
+    from tpu_tfrecord.models import lm, pipeline
+    from tpu_tfrecord.tpu import create_mesh
+
+    out = {}
+    # --- pipeline memory shape at bench scale: what ONE device holds of
+    # the microbatch stream, vs the old replicated-[M, mb, ...] layout
+    s_axis, m, mb = 8, 32, (8, 128)
+    mesh = create_mesh({"pipe": s_axis})
+    rng = np.random.default_rng(0)
+    params = {
+        "w": jnp.asarray(
+            rng.normal(size=(s_axis, mb[1], mb[1])) * 0.1, jnp.float32
+        )
+    }
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    xs = jnp.zeros((m,) + mb, jnp.float32)
+    xs_sh = jax.device_put(xs, pipeline.microbatch_sharding(mesh, ndim=3))
+    p_sh = jax.device_put(params, NamedSharding(mesh, P("pipe")))
+    comp = (
+        jax.jit(lambda p, xs: pipeline.pipeline_apply(stage_fn, p, xs, mesh))
+        .lower(p_sh, xs_sh)
+        .compile()
+    )
+    hlo = comp.as_text()
+    mb_bytes = int(np.prod(mb)) * 4
+    new_bytes = (m // s_axis) * mb_bytes       # the shard one device holds
+    old_bytes = m * mb_bytes                   # the replicated layout held M
+    ma = comp.memory_analysis()
+    out["pipeline_input_bytes_per_device_old"] = old_bytes
+    out["pipeline_input_bytes_per_device_new"] = new_bytes
+    out["pipeline_input_shrink"] = round(old_bytes / new_bytes, 2)
+    out["pipeline_shape"] = f"M={m} stages={s_axis} mb={list(mb)} f32"
+    out["pipeline_hlo_pins"] = {
+        "collective_permute": "collective-permute" in hlo,
+        "all_gather": "all-gather" in hlo,       # must be False
+        "all_reduce": "all-reduce" in hlo,       # must be False
+    }
+    if ma is not None:
+        out["pipeline_compiled_arg_bytes_per_device"] = int(
+            ma.argument_size_in_bytes
+        )
+
+    # --- causal-LM train rate: the examples/train_lm.py default shape
+    # (dp×sp zigzag causal ring) on synthetic packed batches
+    mesh2 = create_mesh({"data": 4, "seq": 2})
+    cfg = lm.LMConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=2, max_len=64
+    )
+    lm_params = lm.init_params(jax.random.key(0), cfg)
+    tx = optax.adam(3e-3)
+    opt = tx.init(lm_params)
+    step = jax.jit(
+        functools.partial(
+            lm.train_step, cfg=cfg, tx=tx, mesh=mesh2, data_axis="data",
+            seq_axis="seq",
+        ),
+        donate_argnums=(0, 1),
+    )
+    toks = jnp.asarray(lm.make_synthetic_tokens(cfg, 32, seed=0))
+    for _ in range(2):  # compile + warm
+        lm_params, opt, loss = step(lm_params, opt, toks)
+    jax.block_until_ready(loss)
+    seconds = float(os.environ.get("TFR_BENCH_LM_SECONDS", 3.0))
+    t0 = time.perf_counter()
+    n = 0
+    while time.perf_counter() - t0 < seconds:
+        lm_params, opt, loss = step(lm_params, opt, toks)
+        n += 1
+    jax.block_until_ready(loss)
+    out["lm_steps_per_s"] = round(n / (time.perf_counter() - t0), 2)
+    out["lm_shape"] = "B=32 L=64 d=64 2L zigzag-ring dp4xsp2"
+    print(json.dumps(out), flush=True)
+
+
+def _model_parallel_probe() -> dict:
+    """Model-parallel leg (ISSUE 10): per-device input-buffer bytes for the
+    pipelined step (old replicated shape vs the new O(mb) shard) and a
+    train_lm steps/s number, measured in a SUBPROCESS that forces an
+    8-device CPU backend — pre-backend-init in the parent, so a dead TPU
+    tunnel still certifies the memory shape (same pattern as the service
+    probe's worker subprocesses)."""
+    import subprocess
+    import sys as _sys
+
+    env = dict(os.environ)
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("JAX_PLATFORM_NAME", None)
+    here = os.path.abspath(__file__)
+    try:
+        proc = subprocess.run(
+            [_sys.executable, here, "--model-parallel-child"],
+            env=env,
+            cwd=os.path.dirname(here),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            timeout=600,
+        )
+    except subprocess.TimeoutExpired:
+        # a hung child (stuck compile on a loaded box) must land as a
+        # structured error field, not crash the whole artifact
+        return {"model_parallel_error": "child exceeded 600s"}
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return {
+        "model_parallel_error": (
+            f"child rc={proc.returncode}: {proc.stdout[-500:]}"
+        )
+    }
+
+
 # Self-flagging regression check (ROADMAP #5): the artifact compares its
 # own numbers against the previous round's and flags anything outside a
 # per-field noise band — r5's host_side 1.32M vs r4's 1.51M went
@@ -1028,6 +1167,11 @@ def _service_probe(data_dir, schema, hash_buckets, pack) -> dict:
 # the disk (cold) or the shaped tunnel (value/sustained) swings wildly.
 _PREV_NOISE_BANDS = {
     "host_side_value": 0.15,
+    # model-parallel leg: the memory-shape ratio is deterministic (a drop
+    # means the pipeline regressed to a replicated layout), the LM rate is
+    # a compiled CPU loop on a shared box
+    "pipeline_input_shrink": 0.10,
+    "lm_steps_per_s": 0.50,
     "remote_http_cold_value": 0.50,
     "remote_http_cached_value": 0.35,
     "seq_host_value": 0.25,
@@ -1212,6 +1356,11 @@ def main() -> None:
             service_info["service"]["vs_host_side"] = round(
                 service_info["service_value"] / host_side_value, 3
             )
+    model_parallel_info = None
+    if os.environ.get("TFR_BENCH_MODEL", "1") != "0":
+        # model-parallel memory shape + LM train rate in a CPU-forced
+        # subprocess (~15s incl. compiles, device-free for the parent)
+        model_parallel_info = _model_parallel_probe()
 
     # Measurement attempts land here the moment they complete, so a guard
     # firing later (e.g. the train phase hanging on a dead tunnel) still
@@ -1245,7 +1394,8 @@ def main() -> None:
             }
             for extra in (cold_info, remote_info, remote_http_info,
                           stall_info, warm_info, telemetry_info,
-                          seq_host_info, autotune_info, service_info):
+                          seq_host_info, autotune_info, service_info,
+                          model_parallel_info):
                 if extra is not None:
                     out.update(extra)
             vs_prev = _vs_previous(out)
@@ -1262,7 +1412,8 @@ def main() -> None:
         }
         for extra in (cold_info, remote_info, remote_http_info,
                       stall_info, warm_info, telemetry_info,
-                      seq_host_info, autotune_info, service_info):
+                      seq_host_info, autotune_info, service_info,
+                      model_parallel_info):
             if extra is not None:
                 err.update(extra)
         vs_prev = _vs_previous(err)
@@ -1658,6 +1809,11 @@ def main() -> None:
         # disaggregated data service leg: K worker subprocesses -> 1
         # consumer vs host_side_value (TFR_BENCH_SERVICE=1)
         out.update(service_info)
+    if model_parallel_info is not None:
+        # model-parallel memory shape (per-device pipeline input bytes,
+        # old replicated vs new O(mb) shard) + LM train rate
+        # (TFR_BENCH_MODEL=1)
+        out.update(model_parallel_info)
     if seq_info is not None:
         # ragged SequenceExample decode->pad->device secondary metric
         out.update(seq_info)
@@ -1786,4 +1942,12 @@ def _train_duty_cycle(ds, mesh, hash_buckets, pack, top_mlp, seconds=6.0):
 
 
 if __name__ == "__main__":
+    if "--model-parallel-child" in sys.argv:
+        # subprocess entry for _model_parallel_probe: env already forces
+        # the 8-device CPU backend
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", "cpu")
+        _model_parallel_child()
+        sys.exit(0)
     main()

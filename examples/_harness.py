@@ -1,0 +1,135 @@
+"""Shared train-loop harness for the examples.
+
+The three trainers (train_dlrm, train_longdoc, train_lm) share one loop
+shape: duty-cycled wait/step windows with a one-deep device pipeline
+(block on step N-1's loss inside the busy window while the host prepares
+batch N+1), checkpoint cadence, an end-of-run summary with the gauge-safe
+stage-throughput snapshot, and fingerprint-tolerant resume. That shape
+lives here ONCE; each example keeps only its data/model specifics.
+
+Import order matters: examples run as scripts, so each one inserts the
+repo root on sys.path and calls ``tpu_tfrecord.ensure_jax_platform()``
+BEFORE importing this module (a dead device tunnel makes backend
+discovery hang even under JAX_PLATFORMS=cpu).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Iterator, Optional, Tuple
+
+import jax
+
+from tpu_tfrecord import checkpoint
+from tpu_tfrecord.metrics import METRICS
+from tpu_tfrecord.tracing import DutyCycle
+
+
+def resume_or_fresh(ds, ckpt_dir: str):
+    """(iterator, resume_state): open ``ds.batches`` at the saved input
+    position when one exists and still matches the dataset fingerprint; a
+    state saved under a different dataset config starts fresh with a loud
+    line rather than dying."""
+    resume = checkpoint.load_state(ckpt_dir)
+    print("resuming from", resume) if resume else print("fresh start")
+    try:
+        return ds.batches(resume), resume
+    except ValueError as e:
+        print(f"saved input state incompatible ({e}); starting fresh")
+        return ds.batches(None), None
+
+
+def stage_throughput() -> dict:
+    """records/sec per pipeline stage. Gauges share the snapshot namespace
+    with a distinct {"gauge": v} shape, and pure event counters ride the
+    ``records`` field with ~zero seconds (their "rate" is meaningless) —
+    only entries with both records AND measured time are real stages."""
+    return {
+        k: round(v["records_per_sec"])
+        for k, v in METRICS.snapshot().items()
+        if v.get("records") and v.get("seconds")
+    }
+
+
+def run_train_loop(
+    it,
+    produce: Callable,
+    step_fn: Callable,
+    state: Tuple,
+    *,
+    save: Optional[Callable[[int, object, object], None]] = None,
+    save_every: int = 8,
+    log_every: int = 8,
+    on_step: Optional[Callable[[int, object], None]] = None,
+    max_steps: Optional[int] = None,
+) -> Tuple[Tuple, int, DutyCycle]:
+    """The shared duty-cycled loop.
+
+    - ``it``: the dataset's batch iterator (supports next(it, None)).
+    - ``produce(cb) -> global_batch``: host prep + device placement; runs
+      inside the WAIT window — it covers everything the host does between
+      steps, including blocking on the prefetch queue, so the duty cycle
+      cannot inflate exactly when the input pipeline is the bottleneck.
+    - ``step_fn(state, gb) -> (state, loss)``: the jitted update; the
+      PREVIOUS loss is blocked inside the busy window (its device time)
+      and the next step dispatches async — a one-deep pipeline where host
+      prep of batch N+1 overlaps device compute of batch N.
+    - ``save(step, it, state)``: checkpoint cadence (every ``save_every``
+      steps, aligned with the log line); receives the live train state so
+      model checkpoints never need to smuggle it out of the loop.
+    - ``on_step(step, loss)``: per-step hook AFTER the loss is known
+      (train_lm logs step/digest/loss lines through it).
+
+    Returns (state, steps, duty).
+    """
+    step = 0
+    duty = DutyCycle()
+    prev_loss = None
+    while max_steps is None or step < max_steps:
+        with duty.wait():
+            cb = next(it, None)
+            gb = produce(cb) if cb is not None else None
+        with duty.step():
+            if prev_loss is not None:
+                jax.block_until_ready(prev_loss)
+            if gb is not None:
+                state, prev_loss = step_fn(state, gb)
+        if cb is None:
+            break
+        step += 1
+        if on_step is not None and prev_loss is not None:
+            jax.block_until_ready(prev_loss)
+            on_step(step, prev_loss)
+        if step % log_every == 0 and prev_loss is not None:
+            print(f"step {step}  loss ~{float(prev_loss):.4f}", flush=True)
+        if save is not None and step % save_every == 0:
+            save(step, it, state)
+    if prev_loss is not None:
+        jax.block_until_ready(prev_loss)
+    return state, step, duty
+
+
+def finish(
+    ckpt_dir: Optional[str],
+    step: int,
+    batch_size: int,
+    t0: float,
+    duty: DutyCycle,
+    clear_state: bool = True,
+    stages: bool = False,
+) -> None:
+    """End-of-run bookkeeping shared by the examples: clear the input
+    state when the epoch budget is exhausted (so the next run starts a
+    fresh pass instead of resuming into an empty stream), print the
+    examples/s line, the duty cycle, and optionally the stage table."""
+    if clear_state and ckpt_dir is not None:
+        state_file = checkpoint.state_path(ckpt_dir)
+        if os.path.exists(state_file):
+            os.remove(state_file)
+    dt = time.perf_counter() - t0
+    print(f"done: {step} steps, {step * batch_size / dt:,.0f} examples/s")
+    if duty.value() is not None:
+        print(f"device duty cycle: {duty.value():.1%}")
+    if stages:
+        print("stage throughput:", stage_throughput())

@@ -31,10 +31,11 @@ tpu_tfrecord.ensure_jax_platform()
 import numpy as np
 import optax
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _harness
+
 from tpu_tfrecord import checkpoint
 from tpu_tfrecord.io.dataset import TFRecordDataset
-from tpu_tfrecord.metrics import METRICS
-from tpu_tfrecord.tracing import DutyCycle
 from tpu_tfrecord.models import DLRMConfig, init_params, train_step
 from tpu_tfrecord.schema import LongType, StringType, StructField, StructType
 from tpu_tfrecord.serde import TFRecordSerializer, encode_row
@@ -115,71 +116,38 @@ def main() -> None:
     # NOTE: in a real job the input state is saved/restored TOGETHER with the
     # model checkpoint (params/opt_state) at the same step — here only the
     # input position is persisted, to keep the example focused on the data
-    # pipeline.
-    resume = checkpoint.load_state(ckpt_dir)
-    print("resuming from", resume) if resume else print("fresh start")
+    # pipeline (train_lm.py shows the atomic combined checkpoint).
     ds = TFRecordDataset(
         data_dir, batch_size=BATCH, schema=schema, num_epochs=2,
         # two-scale mixing: seeded shard-order shuffle + windowed row
         # shuffle (rows permute across 8-batch windows; resume-exact)
         shuffle=True, shuffle_window=8, seed=0
     )
-    step = 0
-    duty = DutyCycle()
-    prev_loss = None
+
+    def produce(cb):
+        hb = host_batch_from_columnar(
+            cb, ds.schema, hash_buckets=hash_buckets, pack=pack
+        )
+        # standard Criteo dense preprocessing: log(1+x)
+        hb["dense"] = np.log1p(hb["dense"].clip(min=0)).astype(np.float32)
+        hb["label"] = hb["label"].astype(np.float32)
+        return make_global_batch(hb, mesh)
+
+    def step(state, gb):
+        params, opt_state = state
+        params, opt_state, loss = step_fn(params, opt_state, gb)
+        return (params, opt_state), loss
+
     t0 = time.perf_counter()
-    try:
-        it = ds.batches(resume)  # fingerprint validated eagerly
-    except ValueError as e:
-        # a state saved under a different dataset config (fingerprint
-        # mismatch, e.g. before shuffle settings changed) cannot resume —
-        # say why and start fresh rather than dying
-        print(f"saved input state incompatible ({e}); starting fresh")
-        it = ds.batches(None)
+    it, _resume = _harness.resume_or_fresh(ds, ckpt_dir)
     with it:
-        while True:
-            # wait window covers EVERYTHING the host does between steps,
-            # including blocking on the prefetch queue — otherwise the duty
-            # cycle inflates exactly when the input pipeline is the
-            # bottleneck.
-            with duty.wait():
-                cb = next(it, None)
-                if cb is not None:
-                    hb = host_batch_from_columnar(cb, ds.schema, hash_buckets=hash_buckets, pack=pack)
-                    # standard Criteo dense preprocessing: log(1+x)
-                    hb["dense"] = np.log1p(hb["dense"].clip(min=0)).astype(np.float32)
-                    hb["label"] = hb["label"].astype(np.float32)
-                    gb = make_global_batch(hb, mesh)
-            # one-deep pipeline: block on the PREVIOUS step inside the busy
-            # window (its device time), then dispatch the next step async —
-            # host prep of batch N+1 overlaps device compute of batch N.
-            with duty.step():
-                if prev_loss is not None:
-                    jax.block_until_ready(prev_loss)
-                if cb is not None:
-                    params, opt_state, prev_loss = step_fn(params, opt_state, gb)
-            if cb is None:
-                break
-            step += 1
-            if step % 8 == 0 and prev_loss is not None:
-                print(f"step {step}  loss ~{float(prev_loss):.4f}")
-                checkpoint.save_state(ckpt_dir, it, step=step)
-    # The epoch budget is exhausted: clear the input state so the next run
-    # starts a fresh pass instead of resuming into an empty stream.
-    state_file = checkpoint.state_path(ckpt_dir)
-    if os.path.exists(state_file):
-        os.remove(state_file)
-    dt = time.perf_counter() - t0
-    print(f"done: {step} steps, {step * BATCH / dt:,.0f} examples/s")
-    if duty.value() is not None:
-        print(f"device duty cycle: {duty.value():.1%} (target >=95%)")
-    # gauges share the snapshot namespace with a distinct {"gauge": v}
-    # shape — only stage entries carry records/records_per_sec
-    print("stage throughput:", {
-        k: round(v["records_per_sec"])
-        for k, v in METRICS.snapshot().items()
-        if v.get("records")
-    })
+        (params, opt_state), steps, duty = _harness.run_train_loop(
+            it, produce, step, (params, opt_state),
+            save=lambda s, live_it, _state: checkpoint.save_state(
+                ckpt_dir, live_it, step=s
+            ),
+        )
+    _harness.finish(ckpt_dir, steps, BATCH, t0, duty, stages=True)
 
 
 if __name__ == "__main__":

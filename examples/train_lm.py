@@ -1,0 +1,289 @@
+#!/usr/bin/env python
+"""End-to-end example: train the causal LM on packed token SequenceExamples.
+
+The trainer that proves the model-parallel layer (ISSUE 10 / ROADMAP #4):
+  1. generate token documents (a sparse-bigram synthetic language) as
+     SequenceExamples through the io layer
+  2. stream them with TFRecordDataset; pack the ragged docs into dense
+     [B, L+1] causal batches with TokenPacker (no padding, no masks)
+  3. feed the mesh through the double-buffered DeviceIterator
+  4. jit train steps whose attention is ZIGZAG CAUSAL RING over the 'seq'
+     axis (--mesh dp_sp, default), or whose blocks run as PIPELINE stages
+     over the 'pipe' axis (--mesh dp_pp: the dp×pp composed mesh with the
+     scale-shaped O(mb) microbatch stream), or plain dp (--mesh dp)
+  5. checkpoint params + optimizer + IteratorState + packer carry in ONE
+     atomic file every --save-every steps; kill -9 and rerun to resume —
+     the packed-batch stream and the loss curve continue byte-identically
+     (tools/verify.sh pins this)
+
+Run on any JAX backend; for a local simulation:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/train_lm.py
+"""
+
+import argparse
+import functools
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+import tpu_tfrecord
+
+# Without this, a dead device tunnel makes backend discovery hang even
+# under JAX_PLATFORMS=cpu — see ensure_jax_platform.
+tpu_tfrecord.ensure_jax_platform()
+
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _harness
+
+from tpu_tfrecord.io.dataset import IteratorState, TFRecordDataset
+from tpu_tfrecord.io.writer import DatasetWriter
+from tpu_tfrecord.models import lm
+from tpu_tfrecord.options import TFRecordOptions
+from tpu_tfrecord.schema import ArrayType, LongType, StructField, StructType
+from tpu_tfrecord.tpu import DeviceIterator, TokenPacker, create_mesh
+
+VOCAB = 256
+SEQ_LEN = 64
+BATCH = 32
+
+
+def make_schema() -> StructType:
+    return StructType([StructField("tokens", ArrayType(LongType()))])
+
+
+def generate(data_dir: str, shards: int = 4, docs: int = 256) -> None:
+    """Token documents from the shared sparse-bigram language, written as
+    SequenceExamples in ONE job (sharded via max_records_per_file) so
+    _SUCCESS can never cover a partial dataset."""
+    if os.path.exists(os.path.join(data_dir, "_SUCCESS")):
+        return
+    rng = np.random.default_rng(0)
+    table = lm.bigram_table(VOCAB, 4)
+    rows = []
+    for _ in range(shards * docs):
+        n = int(rng.integers(16, 97))
+        t = int(rng.integers(VOCAB))
+        doc = np.empty(n, np.int64)
+        for j in range(n):
+            doc[j] = t
+            t = int(table[t, rng.integers(4)])
+        rows.append([doc.tolist()])
+    DatasetWriter(
+        data_dir,
+        make_schema(),
+        TFRecordOptions.from_map(recordType="SequenceExample"),
+        mode="overwrite",
+        max_records_per_file=docs,
+    ).write_rows(rows)
+
+
+def pick_mesh(kind: str):
+    """(mesh, cfg axes, n_layers) for the requested parallelism on however
+    many devices exist (odd counts degrade to dp)."""
+    n_dev = len(jax.devices())
+    if kind == "dp_sp" and n_dev % 2 == 0:
+        mesh = create_mesh({"data": n_dev // 2, "seq": 2})
+        return mesh, {"data_axis": "data", "seq_axis": "seq"}, 2
+    if kind == "dp_pp" and n_dev % 4 == 0:
+        mesh = create_mesh({"pipe": 4, "data": n_dev // 4})
+        return mesh, {"data_axis": "data", "pipe_axis": "pipe"}, 4
+    if kind == "dp_pp" and n_dev % 2 == 0:
+        mesh = create_mesh({"pipe": 2, "data": n_dev // 2})
+        return mesh, {"data_axis": "data", "pipe_axis": "pipe"}, 4
+    mesh = create_mesh({"data": n_dev})
+    return mesh, {"data_axis": "data"}, 2
+
+
+class LMCheckpoint:
+    """Params + optimizer + input position + packer carry, ONE atomic npz.
+
+    A kill between two files would pair step-N params with a stale input
+    position (the skew TrainCheckpointer exists to prevent); one
+    os.replace removes the window entirely. The pytree structure is
+    rebuilt from the caller's live template, so only leaves are stored.
+    TrainCheckpointer (tpu_tfrecord.checkpoint) is the maintained orbax
+    path for real jobs; this example deliberately stays numpy+stdlib so
+    it runs where the optional orbax package is absent.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def save(self, step: int, state, payload: dict) -> None:
+        leaves, _ = jax.tree.flatten(state)
+        arrays = {
+            f"leaf_{i}": np.asarray(a) for i, a in enumerate(leaves)
+        }
+        meta = json.dumps({"step": step, **payload}).encode()
+        tmp = f"{self.path}.tmp.{os.getpid()}.npz"
+        with open(tmp, "wb") as fh:
+            np.savez(fh, meta=np.frombuffer(meta, np.uint8), **arrays)
+        os.replace(tmp, self.path)
+
+    def load(self, template):
+        """(step, state, payload) or (None, template, None)."""
+        if not os.path.exists(self.path):
+            return None, template, None
+        with np.load(self.path) as z:
+            meta = json.loads(z["meta"].tobytes().decode())
+            leaves = [z[f"leaf_{i}"] for i in range(len(z.files) - 1)]
+        _, treedef = jax.tree.flatten(template)
+        state = jax.tree.unflatten(treedef, leaves)
+        return meta["step"], state, meta
+
+
+def packed_stream(it, packer: TokenPacker, snaps: dict):
+    """Columnar batches -> packed host batches; records, for packed batch
+    n, the (IteratorState, packer carry, digest) snapshot that resumes the
+    stream at batch n+1. The DeviceIterator runs this at most one batch
+    ahead, so ``snaps`` stays small (pruned to the last 16)."""
+    n = 0
+    while True:
+        b = packer.pop()
+        while b is None:
+            cb = next(it, None)
+            if cb is None:
+                return
+            packer.feed_column(cb["tokens"])
+            b = packer.pop()
+        snaps[n] = {
+            "input": it.state().to_json(),
+            "packer": packer.state(),
+            "digest": hashlib.sha256(
+                np.ascontiguousarray(b).tobytes()
+            ).hexdigest()[:16],
+        }
+        for old in [k for k in snaps if k < n - 16]:
+            del snaps[old]
+        yield {"tokens": b}
+        n += 1
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--mesh", default=os.environ.get("LM_MESH", "dp_sp"),
+                    choices=("dp", "dp_sp", "dp_pp"))
+    ap.add_argument("--steps", type=int, default=64,
+                    help="total train steps (absolute, incl. resumed)")
+    ap.add_argument("--save-every", type=int, default=8)
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--digest-out", default=None,
+                    help="write one {'step','digest','loss'} JSON line per "
+                         "step (the kill/resume byte-identity evidence)")
+    ap.add_argument("--data-dir", default="/tmp/tpu_tfrecord_lm/data")
+    ap.add_argument("--ckpt-dir", default="/tmp/tpu_tfrecord_lm/ckpt")
+    args = ap.parse_args()
+
+    generate(args.data_dir)
+    mesh, axes, n_layers = pick_mesh(args.mesh)
+    cfg = lm.LMConfig(
+        vocab_size=VOCAB, d_model=64, n_heads=4, n_layers=n_layers,
+        max_len=SEQ_LEN, n_micro=8 if "pipe_axis" in axes else None,
+    )
+    print(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))} "
+          f"mode={args.mesh}")
+
+    params = lm.init_params(jax.random.key(0), cfg)
+    tx = optax.adam(3e-3)
+    opt_state = tx.init(params)
+    os.makedirs(args.ckpt_dir, exist_ok=True)
+    ck = LMCheckpoint(os.path.join(args.ckpt_dir, "lm_state.npz"))
+    start_step, (params, opt_state), payload = ck.load((params, opt_state))
+    if "pipe_axis" in axes:
+        params = jax.device_put(
+            params,
+            lm.param_shardings(mesh, params, pipe_axis=axes["pipe_axis"]),
+        )
+    if start_step is None:
+        start_step = 0
+        print("fresh start")
+    else:
+        print(f"resumed at step {start_step}")
+
+    ds = TFRecordDataset(
+        args.data_dir, batch_size=64, schema=make_schema(),
+        num_epochs=args.epochs, recordType="SequenceExample",
+        shuffle=True, seed=0,
+    )
+    resume = (
+        IteratorState.from_json(payload["input"]) if payload else None
+    )
+    packer = TokenPacker(BATCH, SEQ_LEN)
+    if payload:
+        packer.restore(payload["packer"])
+
+    step_jit = jax.jit(
+        functools.partial(lm.train_step, cfg=cfg, tx=tx, mesh=mesh, **axes),
+        donate_argnums=(0, 1),
+    )
+    snaps: dict = {}
+    digest_fh = open(args.digest_out, "a") if args.digest_out else None
+
+    def step_fn(state, gb):
+        p, o = state
+        p, o, loss = step_jit(p, o, gb["tokens"])
+        return (p, o), loss
+
+    def save(rel_step, _it, state):
+        snap = snaps.get(rel_step - 1)  # stream position AFTER that batch
+        if snap is None:
+            return
+        ck.save(
+            start_step + rel_step, state,
+            {"input": snap["input"], "packer": snap["packer"]},
+        )
+
+    def on_step(rel_step, loss):
+        step = start_step + rel_step
+        snap = snaps.get(rel_step - 1, {})
+        line = {
+            "step": step,
+            "digest": snap.get("digest"),
+            "loss": repr(float(loss)),
+        }
+        print("lm_step", json.dumps(line), flush=True)
+        if digest_fh is not None:
+            digest_fh.write(json.dumps(line) + "\n")
+            digest_fh.flush()
+
+    t0 = time.perf_counter()
+    with ds.batches(resume) as it:
+        with DeviceIterator(
+            packed_stream(it, packer, snaps), mesh, axis=axes["data_axis"]
+        ) as dev_it:
+            (params, opt_state), steps, duty = _harness.run_train_loop(
+                dev_it,
+                produce=lambda gb: gb,  # DeviceIterator already placed it
+                step_fn=step_fn,
+                state=(params, opt_state),
+                save=save,
+                save_every=args.save_every,
+                on_step=on_step if digest_fh is not None else None,
+                max_steps=(
+                    args.steps - start_step if args.steps else None
+                ),
+            )
+    if digest_fh is not None:
+        digest_fh.close()
+    completed = args.steps and start_step + steps >= args.steps
+    if not completed and os.path.exists(ck.path):
+        # the epoch budget is exhausted: next run starts a fresh pass
+        os.remove(ck.path)
+    _harness.finish(
+        None, start_step + steps, BATCH, t0, duty, clear_state=False,
+        stages=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -34,6 +34,9 @@ tpu_tfrecord.ensure_jax_platform()
 import numpy as np
 import optax
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _harness
+
 import tpu_tfrecord.io as tfio
 from tpu_tfrecord import checkpoint
 from tpu_tfrecord.io.dataset import TFRecordDataset
@@ -47,7 +50,6 @@ from tpu_tfrecord.schema import (
 )
 from tpu_tfrecord.tpu import make_global_batch
 from tpu_tfrecord.tpu.mesh import create_mesh
-from tpu_tfrecord.tracing import DutyCycle
 
 SEQ_DIM = 16
 MAX_LEN = 64
@@ -131,8 +133,6 @@ def main() -> None:
         donate_argnums=(0, 1),
     )
 
-    resume = checkpoint.load_state(ckpt_dir)
-    print("resuming from", resume) if resume else print("fresh start")
     ds = TFRecordDataset(
         data_dir, batch_size=BATCH, schema=schema, num_epochs=2,
         recordType="SequenceExample", shuffle=True, seed=0,
@@ -141,45 +141,36 @@ def main() -> None:
 
     from tpu_tfrecord.tpu import host_batch_from_columnar
 
-    step = 0
-    duty = DutyCycle()
-    prev_loss = None
-    shardings = None  # computed once; frames carries the (data, seq) spec
+    shardings = {}  # computed once; frames carries the (data, seq) spec
+
+    def produce(cb):
+        # pad + f32->bf16 fused in the native kernel: frames arrive in the
+        # model's compute dtype at half the link bytes, with no host-side
+        # f32 dense batch
+        hb = host_batch_from_columnar(
+            cb, ds.schema, pad_to={"frames": (MAX_LEN, SEQ_DIM)},
+            cast={"frames": ml_dtypes.bfloat16},
+        )
+        hb.pop("frames_inner_len")
+        if not shardings:
+            shardings.update(long_doc.batch_shardings(mesh, hb))
+        return make_global_batch(hb, mesh, shardings=shardings)
+
+    def step(state, gb):
+        params, opt_state = state
+        params, opt_state, loss = step_fn(params, opt_state, gb)
+        return (params, opt_state), loss
+
     t0 = time.perf_counter()
-    with ds.batches(resume) as it:
-        while True:
-            with duty.wait():
-                cb = next(it, None)
-                if cb is not None:
-                    # pad + f32->bf16 fused in the native kernel: frames
-                    # arrive in the model's compute dtype at half the link
-                    # bytes, with no host-side f32 dense batch
-                    hb = host_batch_from_columnar(
-                        cb, ds.schema, pad_to={"frames": (MAX_LEN, SEQ_DIM)},
-                        cast={"frames": ml_dtypes.bfloat16},
-                    )
-                    hb.pop("frames_inner_len")
-                    if shardings is None:
-                        shardings = long_doc.batch_shardings(mesh, hb)
-                    gb = make_global_batch(hb, mesh, shardings=shardings)
-            with duty.step():
-                if prev_loss is not None:
-                    jax.block_until_ready(prev_loss)
-                if cb is not None:
-                    params, opt_state, prev_loss = step_fn(params, opt_state, gb)
-            if cb is None:
-                break
-            step += 1
-            if step % 8 == 0 and prev_loss is not None:
-                print(f"step {step}  loss ~{float(prev_loss):.4f}")
-                checkpoint.save_state(ckpt_dir, it, step=step)
-    state_file = checkpoint.state_path(ckpt_dir)
-    if os.path.exists(state_file):
-        os.remove(state_file)
-    dt = time.perf_counter() - t0
-    print(f"done: {step} steps, {step * BATCH / dt:,.0f} examples/s")
-    if duty.value() is not None:
-        print(f"device duty cycle: {duty.value():.1%}")
+    it, _resume = _harness.resume_or_fresh(ds, ckpt_dir)
+    with it:
+        (params, opt_state), steps, duty = _harness.run_train_loop(
+            it, produce, step, (params, opt_state),
+            save=lambda s, live_it, _state: checkpoint.save_state(
+                ckpt_dir, live_it, step=s
+            ),
+        )
+    _harness.finish(ckpt_dir, steps, BATCH, t0, duty)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,55 @@
+"""Shared HLO-pin helpers: compile a function and assert which collectives
+the backend actually emitted.
+
+The model-parallel layer's contracts are COMMS contracts — "activations hop
+by collective-permute", "EP dispatch is an all-to-all", "nothing gathers
+the sharded stream" — and the only place those are real is the compiled
+HLO. Every pin goes through `assert_hlo` so the idiom (lower -> compile ->
+as_text -> grep) lives once, and through `per_device_argument_bytes` for
+the memory-shape pins (what one device actually holds of the inputs).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+import jax
+
+
+def compiled_hlo(fn, *args, **kwargs) -> str:
+    """Compiled (post-SPMD-partitioning) HLO text of ``fn(*args)``.
+    ``fn`` may already be jitted; sharded example args pin their layouts."""
+    if not hasattr(fn, "lower"):
+        fn = jax.jit(fn)
+    return fn.lower(*args, **kwargs).compile().as_text()
+
+
+def assert_hlo(
+    fn,
+    args: Sequence,
+    contains: Iterable[str] = (),
+    absent: Iterable[str] = (),
+) -> str:
+    """Compile ``fn(*args)`` and assert substrings of the HLO text.
+
+    ``contains``: ops that MUST appear (e.g. "collective-permute",
+    "all-to-all"); ``absent``: ops that must NOT (e.g. "all-gather").
+    Returns the HLO text for any further custom checks.
+    """
+    hlo = compiled_hlo(fn, *args)
+    for op in contains:
+        assert op in hlo, f"expected {op!r} in compiled HLO, not found"
+    for op in absent:
+        assert op not in hlo, f"forbidden {op!r} present in compiled HLO"
+    return hlo
+
+
+def per_device_argument_bytes(fn, *args) -> int:
+    """Per-device bytes of ``fn``'s compiled arguments — what ONE device
+    holds of the inputs (shards, not global tensors). This is the number
+    the scale-shape pins compare as meshes and microbatch counts grow."""
+    if not hasattr(fn, "lower"):
+        fn = jax.jit(fn)
+    ma = fn.lower(*args).compile().memory_analysis()
+    assert ma is not None, "backend reports no memory analysis"
+    return int(ma.argument_size_in_bytes)

@@ -5,6 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from hlo_util import assert_hlo
 from tpu_tfrecord.models.attention import attention_reference, ring_attention
 from tpu_tfrecord.tpu import create_mesh
 
@@ -100,11 +101,8 @@ class TestRingAttentionMaskAndSharding:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6)
         # batch dim must be sharded on 'data' in the compiled output, and the
         # HLO must not all-gather the batch
-        from jax.sharding import PartitionSpec as P
-
         assert got.sharding.spec[0] == "data"
-        hlo = fn.lower(q, k, v).compile().as_text()
-        assert "all-gather" not in hlo
+        assert_hlo(fn, (q, k, v), absent=["all-gather"])
 
 
 class TestUlyssesAttention:
@@ -166,9 +164,7 @@ class TestUlyssesAttention:
         )
         got = fn(q, k, v)
         assert got.sharding.spec[0] == "data"
-        hlo = fn.lower(q, k, v).compile().as_text()
-        assert "all-to-all" in hlo
-        assert "all-gather" not in hlo
+        assert_hlo(fn, (q, k, v), contains=["all-to-all"], absent=["all-gather"])
 
     def test_bf16_inputs(self):
         from tpu_tfrecord.models.attention import ulysses_attention
@@ -423,9 +419,9 @@ class TestZigzagCausal:
         )
         got = fn(q, k, v)
         assert got.sharding.spec[0] == "data"
-        hlo = fn.lower(q, k, v).compile().as_text()
-        assert "collective-permute" in hlo
-        assert "all-gather" not in hlo
+        assert_hlo(
+            fn, (q, k, v), contains=["collective-permute"], absent=["all-gather"]
+        )
 
     def test_single_device_axis_self_swap(self):
         """p=1: the swap involution is a self-edge; must degenerate to

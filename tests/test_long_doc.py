@@ -224,14 +224,17 @@ class TestTraining:
         hb = long_doc.make_synthetic_batch(CFG, 8, seed=1)
         b_sh = long_doc.batch_shardings(mesh, hb)
         batch = {k: jax.device_put(jnp.asarray(v), b_sh[k]) for k, v in hb.items()}
+        from hlo_util import assert_hlo
+
         fn = jax.jit(
             functools.partial(
                 long_doc.forward, cfg=CFG, mesh=mesh, data_axis="data"
             )
         )
-        hlo = fn.lower(params, batch).compile().as_text()
-        assert "collective-permute" in hlo
-        assert "all-gather" not in hlo
+        assert_hlo(
+            fn, (params, batch),
+            contains=["collective-permute"], absent=["all-gather"],
+        )
 
 
 class TestUlyssesFlavor:

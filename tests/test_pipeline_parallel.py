@@ -1,10 +1,13 @@
-"""GPipe-style pipeline parallelism vs the sequential oracle."""
+"""GPipe-style pipeline parallelism vs the sequential oracle, plus the
+scale-shape pins: sharded input stream, O(mb) collectives, no gathers."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
 
+from hlo_util import assert_hlo, per_device_argument_bytes
 from tpu_tfrecord.models import pipeline
 from tpu_tfrecord.tpu import create_mesh
 
@@ -20,6 +23,17 @@ def make_stages(n_stages=4, d=8, seed=0):
         return jax.nn.gelu(x @ p["w"] + p["b"])
 
     return params, stage_fn
+
+
+def sharded_args(mesh, params, xs, pipe_axis="pipe"):
+    """Place params and the microbatch stream in their pipeline layout:
+    stage-sharded weights, pipe-sharded stream (the scale-shape input
+    contract — no device holds the full [M, mb, ...] tensor)."""
+    p_sh = jax.device_put(params, NamedSharding(mesh, P(pipe_axis)))
+    xs_sh = jax.device_put(
+        xs, pipeline.microbatch_sharding(mesh, pipe_axis, ndim=xs.ndim)
+    )
+    return p_sh, xs_sh
 
 
 class TestPipeline:
@@ -76,12 +90,168 @@ class TestPipeline:
         with pytest.raises(ValueError, match="stack 4 stages"):
             pipeline.pipeline_apply(stage_fn, params, xs, mesh)
 
-    def test_hlo_collective_permute(self):
-        """The activation hops must be neighbor collective-permutes, not
-        gathers of the stacked stage weights."""
+
+class TestScaleShape:
+    """The GSPMD contract the rebuild exists for: per-device memory and
+    communication scale with the SHARD of the microbatch stream, never the
+    global [M, mb, ...] tensor (the old construction replicated it to
+    every stage and psum-broadcast the output)."""
+
+    def _jitted(self, mesh, stage_fn):
+        return jax.jit(
+            lambda p, xs: pipeline.pipeline_apply(stage_fn, p, xs, mesh)
+        )
+
+    def test_hlo_collective_permute_no_gather_no_reduce(self):
+        """Activation/feed/output movement must be neighbor permutes of ONE
+        microbatch slice: no all-gather of the stream, and no all-reduce —
+        the old full-[M, mb, ...] psum broadcast is gone."""
         mesh = create_mesh({"pipe": 4}, jax.devices()[:4])
         params, stage_fn = make_stages()
         xs = jnp.zeros((4, 2, 8), jnp.float32)
-        fn = jax.jit(lambda p, xs: pipeline.pipeline_apply(stage_fn, p, xs, mesh))
-        hlo = fn.lower(params, xs).compile().as_text()
-        assert "collective-permute" in hlo
+        p_sh, xs_sh = sharded_args(mesh, params, xs)
+        assert_hlo(
+            self._jitted(mesh, stage_fn),
+            (p_sh, xs_sh),
+            contains=["collective-permute"],
+            absent=["all-gather", "all-reduce", "all-to-all"],
+        )
+
+    def test_per_device_input_flat_as_pipeline_grows(self):
+        """Weak scaling — the scale shape itself: grow the machine (S) and
+        the stream with it (M = 2S, fixed microbatches per stage) and ONE
+        device's compiled argument bytes stay FLAT. The old replicated
+        layout grew linearly in M even at fixed per-stage load."""
+        sizes = []
+        for s in (2, 4, 8):
+            mesh = create_mesh({"pipe": s}, jax.devices()[:s])
+            params, stage_fn = make_stages(n_stages=s)
+            xs = jnp.zeros((2 * s, 2, 8), jnp.float32)
+            p_sh, xs_sh = sharded_args(mesh, params, xs)
+            sizes.append(
+                per_device_argument_bytes(
+                    self._jitted(mesh, stage_fn), p_sh, xs_sh
+                )
+            )
+        assert sizes[0] == sizes[1] == sizes[2], sizes
+
+    def test_per_device_input_is_the_shard(self):
+        """Fixed S: growing M adds exactly mb_bytes/S per microbatch to one
+        device (the 1/S shard slope; the old replicated input's slope was
+        the full mb_bytes)."""
+        s = 4
+        mesh = create_mesh({"pipe": s}, jax.devices()[:s])
+        params, stage_fn = make_stages(n_stages=s)
+        mb_bytes = 2 * 8 * 4  # [2, 8] f32 slice
+        got = {}
+        for m in (8, 16):
+            xs = jnp.zeros((m, 2, 8), jnp.float32)
+            p_sh, xs_sh = sharded_args(mesh, params, xs)
+            got[m] = per_device_argument_bytes(
+                self._jitted(mesh, stage_fn), p_sh, xs_sh
+            )
+        assert got[16] - got[8] == (16 - 8) * mb_bytes // s, got
+
+    def test_microbatch_sharding_is_block_layout(self):
+        """Device d holds microbatches [d*R, (d+1)*R) and nothing else."""
+        mesh = create_mesh({"pipe": 4}, jax.devices()[:4])
+        xs = jnp.arange(8 * 2 * 8, dtype=jnp.float32).reshape(8, 2, 8)
+        xs_sh = jax.device_put(
+            xs, pipeline.microbatch_sharding(mesh, ndim=xs.ndim)
+        )
+        for d, shard in enumerate(xs_sh.addressable_shards):
+            assert shard.data.shape == (2, 2, 8)
+            np.testing.assert_array_equal(
+                np.asarray(shard.data), np.asarray(xs[2 * d : 2 * d + 2])
+            )
+
+    def test_non_divisible_microbatch_count_pads_invisibly(self):
+        """M % S != 0 pads internally; the caller-visible result is exact."""
+        mesh = create_mesh({"pipe": 4}, jax.devices()[:4])
+        params, stage_fn = make_stages()
+        xs = jnp.asarray(
+            np.random.default_rng(7).normal(size=(7, 2, 8)), jnp.float32
+        )
+        got = jax.jit(
+            lambda p, xs: pipeline.pipeline_apply(stage_fn, p, xs, mesh)
+        )(params, xs)
+        want = pipeline.pipeline_reference(stage_fn, params, xs)
+        assert got.shape == (7, 2, 8)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6
+        )
+
+
+class TestDpPpComposition:
+    """batch_spec shards the PER-MICROBATCH dims over further axes: the
+    dp×pp composed mesh ROADMAP #4a names."""
+
+    def test_matches_oracle_on_composed_mesh(self):
+        mesh = create_mesh({"pipe": 4, "data": 2})
+        params, stage_fn = make_stages()
+        xs = jnp.asarray(
+            np.random.default_rng(3).normal(size=(8, 4, 8)), jnp.float32
+        )
+        want = pipeline.pipeline_reference(stage_fn, params, xs)
+        p_sh = jax.device_put(params, NamedSharding(mesh, P("pipe")))
+        xs_sh = jax.device_put(
+            xs,
+            pipeline.microbatch_sharding(
+                mesh, ndim=xs.ndim, batch_spec=P("data")
+            ),
+        )
+        got = jax.jit(
+            lambda p, xs: pipeline.pipeline_apply(
+                stage_fn, p, xs, mesh, batch_spec=P("data")
+            )
+        )(p_sh, xs_sh)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6
+        )
+
+    def test_composed_grads_match_sequential(self):
+        mesh = create_mesh({"pipe": 4, "data": 2})
+        params, stage_fn = make_stages()
+        xs = jnp.asarray(
+            np.random.default_rng(4).normal(size=(4, 4, 8)), jnp.float32
+        )
+
+        def loss_p(p, xs):
+            return (
+                pipeline.pipeline_apply(
+                    stage_fn, p, xs, mesh, batch_spec=P("data")
+                )
+                ** 2
+            ).sum()
+
+        def loss_r(p, xs):
+            return (pipeline.pipeline_reference(stage_fn, p, xs) ** 2).sum()
+
+        g = jax.jit(jax.grad(loss_p))(params, xs)
+        g_ref = jax.grad(loss_r)(params, xs)
+        for k in g:
+            np.testing.assert_allclose(
+                np.asarray(g[k]), np.asarray(g_ref[k]), rtol=1e-4, atol=1e-5
+            )
+
+    def test_composed_hlo_still_gather_free(self):
+        mesh = create_mesh({"pipe": 4, "data": 2})
+        params, stage_fn = make_stages()
+        xs = jnp.zeros((8, 4, 8), jnp.float32)
+        p_sh = jax.device_put(params, NamedSharding(mesh, P("pipe")))
+        xs_sh = jax.device_put(
+            xs,
+            pipeline.microbatch_sharding(
+                mesh, ndim=xs.ndim, batch_spec=P("data")
+            ),
+        )
+        assert_hlo(
+            jax.jit(
+                lambda p, xs: pipeline.pipeline_apply(
+                    stage_fn, p, xs, mesh, batch_spec=P("data")
+                )
+            ),
+            (p_sh, xs_sh),
+            contains=["collective-permute"],
+            absent=["all-gather"],
+        )

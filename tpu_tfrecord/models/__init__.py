@@ -16,7 +16,13 @@ families are in-tree:
   (expert-stacked weights sharded over a mesh axis, static-shape one-hot
   dispatch/combine).
 - ``pipeline``: GPipe-style pipeline parallelism (stage weights sharded
-  one-per-device on a 'pipe' axis, microbatches hop via ppermute).
+  one-per-device on a 'pipe' axis, microbatches hop via ppermute;
+  scale-shaped — the stream is sharded on the pipe axis and per-device
+  input is O(mb)).
+- ``lm``: a causal (decoder) language model — the end-to-end consumer
+  proving zigzag causal ring attention, the pipelined blocks, and the
+  all-to-all MoE inside one jitted, checkpointed train step
+  (examples/train_lm.py).
 
 Together the families exercise dp, tp, sp, ep, and pp on one mesh design
 (all five run inside ``__graft_entry__.dryrun_multichip``).
@@ -28,7 +34,7 @@ with a specific family, the function names intentionally mirror each
 other.
 """
 
-from tpu_tfrecord.models import dlrm, long_doc, moe, pipeline
+from tpu_tfrecord.models import dlrm, lm, long_doc, moe, pipeline
 from tpu_tfrecord.models.dlrm import (
     DLRMConfig,
     SparseEmbOptState,
@@ -44,6 +50,7 @@ from tpu_tfrecord.models.dlrm import (
 
 __all__ = [
     "dlrm",
+    "lm",
     "long_doc",
     "moe",
     "pipeline",

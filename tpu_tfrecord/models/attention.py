@@ -49,6 +49,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpu_tfrecord.models._compat import axis_size, shard_map
+
 _NEG = jnp.float32(-1e30)  # mask value; avoids inf-inf NaNs for empty rows
 
 
@@ -103,7 +105,7 @@ def _ring_attention_local(
     masked block). Work is balanced per step AND per device, at half the
     dense FLOPs; the output swaps back before return, so callers keep the
     contiguous [B, L, ...] contract end to end."""
-    p = jax.lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     if zigzag:
         swap = [(j, p - 1 - j) for j in range(p)]
@@ -275,7 +277,7 @@ def _shard_map_attention(
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     spec = P(data_axis, seq_axis, None, None)
     if lengths is None:
-        fn = jax.shard_map(
+        fn = shard_map(
             functools.partial(
                 local_fn, lengths=None, scale=scale, axis_name=seq_axis,
                 causal=causal, **local_kwargs,
@@ -285,7 +287,7 @@ def _shard_map_attention(
             out_specs=spec,
         )
         return fn(q, k, v)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             local_fn, scale=scale, axis_name=seq_axis, causal=causal,
             **local_kwargs,

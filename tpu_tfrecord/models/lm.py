@@ -1,0 +1,337 @@
+"""Causal language model: the consumer that proves the model-parallel
+layer end to end.
+
+The repo's most intricate compute (zigzag causal ring attention), its
+scale-shaped pipeline (models.pipeline), and its pinned all-to-all MoE
+dispatch (models.moe) have oracles but — before this model — no jitted,
+checkpointed train step consuming real ingested data. This decoder LM is
+that consumer: packed token batches from `tpu_tfrecord.tpu.ingest.TokenPacker`
+-> next-token cross-entropy, with the parallelism style picked by which
+mesh axes the caller passes:
+
+- no mesh / dp only            -> dense causal attention (the reference
+                                  trajectory every other mode must match)
+- ``seq_axis``                 -> ZIGZAG causal ring attention over the
+                                  sequence (models.attention, balanced
+                                  causal schedule, ppermute K/V rotation)
+- ``pipe_axis``                -> transformer blocks stacked as pipeline
+                                  stages through `pipeline_apply` — the
+                                  dp×pp composed mesh; attention is dense
+                                  per stage (a stage's shard_map already
+                                  owns the device, so the sequence stays
+                                  whole within it)
+- ``expert_axis``              -> every block's FFN swaps for the top-k
+                                  MoE with the PINNED all-to-all dispatch
+                                  (`moe_apply_ep`)
+
+All modes share one parameter pytree (blocks stacked on a leading
+[n_layers, ...] dim — exactly the pipeline's stage layout), so the same
+checkpoint trains under any mesh and the composition tests can demand
+same-params same-data same-loss-trajectory across modes.
+
+TPU shaping follows models.long_doc: pre-norm residual blocks, batched
+matmuls, one jit per train step, no data-dependent control flow.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_tfrecord.models import moe as _moe
+from tpu_tfrecord.models import pipeline as _pipeline
+from tpu_tfrecord.models.attention import attention_reference, ring_attention
+from tpu_tfrecord.models.long_doc import _rms_norm
+
+
+@dataclass(frozen=True)
+class LMConfig:
+    vocab_size: int = 256
+    d_model: int = 32
+    n_heads: int = 4
+    n_layers: int = 2
+    mlp_mult: int = 4
+    max_len: int = 64        # L: the model reads L tokens, predicts L
+    dtype: Any = jnp.float32
+    # 'seq'-axis attention flavor: zigzag (balanced causal ring) is the
+    # default — the schedule this model exists to prove; False falls back
+    # to the contiguous causal ring
+    zigzag: bool = True
+    # > 0 swaps every block's dense FFN for the top-k MoE (models.moe);
+    # with an ``expert_axis`` the dispatch is the pinned all-to-all EP
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    # microbatches for the pipeline mode (must divide the batch); None =
+    # 2 × pipe-axis size (a 2-slice block per device, 2/3 efficiency)
+    n_micro: Optional[int] = None
+
+
+def _dense_init(rng, fan_in: int, fan_out: int):
+    kw, kb = jax.random.split(rng)
+    scale = (1.0 / fan_in) ** 0.5
+    return {
+        "w": jax.random.normal(kw, (fan_in, fan_out), jnp.float32) * scale,
+        "b": jax.random.normal(kb, (fan_out,), jnp.float32) * 0.0,
+    }
+
+
+def _dense(layer, x, dt):
+    return x @ layer["w"].astype(dt) + layer["b"].astype(dt)
+
+
+def init_params(rng: jax.Array, cfg: LMConfig) -> Dict[str, Any]:
+    if cfg.d_model % cfg.n_heads:
+        raise ValueError(
+            f"n_heads ({cfg.n_heads}) must divide d_model ({cfg.d_model})"
+        )
+    keys = jax.random.split(rng, 3 + cfg.n_layers)
+    params: Dict[str, Any] = {
+        "embed": jax.random.normal(
+            keys[0], (cfg.vocab_size, cfg.d_model), jnp.float32
+        )
+        * 0.02,
+        "pos": jax.random.normal(
+            keys[1], (cfg.max_len, cfg.d_model), jnp.float32
+        )
+        * 0.02,
+        "head": _dense_init(keys[2], cfg.d_model, cfg.vocab_size),
+    }
+    layers = []
+    for i in range(cfg.n_layers):
+        k = jax.random.split(keys[3 + i], 4)
+        layer = {
+            "qkv": _dense_init(k[0], cfg.d_model, 3 * cfg.d_model),
+            "proj": _dense_init(k[1], cfg.d_model, cfg.d_model),
+        }
+        if cfg.moe_experts > 0:
+            layer["moe"] = _moe.init_params(k[2], _moe_cfg(cfg))
+        else:
+            layer["mlp_in"] = _dense_init(
+                k[2], cfg.d_model, cfg.mlp_mult * cfg.d_model
+            )
+            layer["mlp_out"] = _dense_init(
+                k[3], cfg.mlp_mult * cfg.d_model, cfg.d_model
+            )
+        layers.append(layer)
+    # blocks STACKED on a leading [n_layers, ...] dim: the dense loop
+    # slices it, the pipeline shards it — one checkpoint, every mesh
+    params["blocks"] = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+    return params
+
+
+def _moe_cfg(cfg: LMConfig) -> "_moe.MoEConfig":
+    return _moe.MoEConfig(
+        d_model=cfg.d_model,
+        d_ff=cfg.mlp_mult * cfg.d_model,
+        n_experts=cfg.moe_experts,
+        capacity_factor=cfg.moe_capacity_factor,
+        top_k=cfg.moe_top_k,
+        dtype=cfg.dtype,
+    )
+
+
+def _block(
+    layer, x, cfg: LMConfig, mesh=None, seq_axis=None, data_axis=None,
+    expert_axis=None,
+):
+    """One pre-norm decoder block on x [B, L, D]. Attention flavor: zigzag
+    causal ring over ``seq_axis`` when given, else dense causal."""
+    dt = cfg.dtype
+    b, l, _ = x.shape
+    h, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
+    qkv = _dense(layer["qkv"], _rms_norm(x), dt)
+    q, k, v = (a.reshape(b, l, h, dh) for a in jnp.split(qkv, 3, axis=-1))
+    if mesh is not None and seq_axis is not None:
+        att = ring_attention(
+            q, k, v, mesh, seq_axis=seq_axis, data_axis=data_axis,
+            causal=True, zigzag=cfg.zigzag,
+        )
+    else:
+        att = attention_reference(q, k, v, causal=True)
+    x = x + _dense(layer["proj"], att.reshape(b, l, cfg.d_model), dt)
+    if cfg.moe_experts > 0:
+        if mesh is not None and expert_axis is not None:
+            y, aux = _moe.moe_apply_ep(
+                layer["moe"], _rms_norm(x), _moe_cfg(cfg), mesh,
+                expert_axis=expert_axis, data_axis=data_axis,
+            )
+        else:
+            y, aux = _moe.moe_apply(layer["moe"], _rms_norm(x), _moe_cfg(cfg))
+        return x + y, aux
+    y = _dense(layer["mlp_in"], _rms_norm(x), dt)
+    return x + _dense(layer["mlp_out"], jax.nn.gelu(y), dt), jnp.float32(0.0)
+
+
+def forward(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: LMConfig,
+    mesh: Optional[Mesh] = None,
+    data_axis: Optional[str] = None,
+    seq_axis: Optional[str] = None,
+    pipe_axis: Optional[str] = None,
+    expert_axis: Optional[str] = None,
+):
+    """tokens [B, L+1] int32 -> (logits [B, L, V] f32, aux f32). The
+    model reads tokens[:, :-1]; the caller scores against tokens[:, 1:]
+    (`loss_fn` does). Mesh axes select the parallelism (module docstring);
+    pipe and seq modes are mutually exclusive (a pipeline stage owns its
+    devices — the sequence stays whole within it)."""
+    if pipe_axis is not None and seq_axis is not None:
+        raise ValueError(
+            "pipe_axis and seq_axis are mutually exclusive: inside a "
+            "pipeline stage the sequence is not sharded"
+        )
+    if pipe_axis is not None and cfg.moe_experts > 0:
+        raise ValueError(
+            "moe_experts > 0 is not supported in the pipeline mode"
+        )
+    dt = cfg.dtype
+    x_tok = tokens[:, :-1]
+    b, l = x_tok.shape
+    if l != cfg.max_len:
+        raise ValueError(
+            f"packed batch carries {l} input tokens but cfg.max_len is "
+            f"{cfg.max_len} (the packer's seq_len must match)"
+        )
+    x = (
+        params["embed"].astype(dt)[x_tok]
+        + params["pos"][:l].astype(dt)[None]
+    )                                                          # [B, L, D]
+    aux_total = jnp.float32(0.0)
+    if pipe_axis is not None:
+        n_stages = mesh.shape[pipe_axis]
+        if cfg.n_layers % n_stages:
+            raise ValueError(
+                f"n_layers ({cfg.n_layers}) must divide into the pipe "
+                f"axis ({n_stages} stages)"
+            )
+        per_stage = cfg.n_layers // n_stages
+        stage_params = jax.tree.map(
+            lambda a: a.reshape((n_stages, per_stage) + a.shape[1:]),
+            params["blocks"],
+        )
+        m = cfg.n_micro or 2 * n_stages
+        if b % m:
+            raise ValueError(f"batch {b} not divisible by n_micro {m}")
+
+        def stage_fn(p_stage, xs):
+            for j in range(per_stage):
+                layer = jax.tree.map(lambda a: a[j], p_stage)
+                xs, _ = _block(layer, xs, cfg)
+            return xs
+
+        xs = x.reshape((m, b // m) + x.shape[1:])              # [M, mb, L, D]
+        batch_spec = P(data_axis) if data_axis else P()
+        xs = _pipeline.pipeline_apply(
+            stage_fn, stage_params, xs, mesh, pipe_axis=pipe_axis,
+            batch_spec=batch_spec,
+        )
+        x = xs.reshape((b,) + xs.shape[2:])
+    else:
+        for i in range(cfg.n_layers):
+            layer = jax.tree.map(lambda a: a[i], params["blocks"])
+            x, aux = _block(
+                layer, x, cfg, mesh=mesh, seq_axis=seq_axis,
+                data_axis=data_axis, expert_axis=expert_axis,
+            )
+            aux_total = aux_total + aux
+    logits = _dense(params["head"], _rms_norm(x), dt).astype(jnp.float32)
+    return logits, aux_total
+
+
+def loss_fn(params, tokens, cfg: LMConfig, mesh=None, data_axis=None,
+            seq_axis=None, pipe_axis=None, expert_axis=None) -> jax.Array:
+    """Mean next-token cross-entropy over every position of the packed
+    batch (packing leaves no padding) + the MoE aux loss."""
+    logits, aux = forward(
+        params, tokens, cfg, mesh, data_axis, seq_axis, pipe_axis,
+        expert_axis,
+    )
+    targets = tokens[:, 1:].astype(jnp.int32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ce = -jnp.mean(
+        jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    )
+    return ce + cfg.moe_aux_weight * aux
+
+
+def train_step(params, opt_state, tokens, cfg: LMConfig, tx, mesh=None,
+               data_axis=None, seq_axis=None, pipe_axis=None,
+               expert_axis=None):
+    """One optimizer step; jit this whole function (mesh static via
+    closure/partial)."""
+    loss, grads = jax.value_and_grad(loss_fn)(
+        params, tokens, cfg, mesh, data_axis, seq_axis, pipe_axis,
+        expert_axis,
+    )
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = jax.tree.map(lambda p, u: p + u, params, updates)
+    return params, opt_state, loss
+
+
+def param_shardings(
+    mesh: Mesh,
+    params,
+    pipe_axis: Optional[str] = None,
+    expert_axis: Optional[str] = None,
+):
+    """Replicate everything except what a mode shards: the stacked block
+    dim on ``pipe_axis`` (stage weights never replicate — that is PP), the
+    expert dim on ``expert_axis`` (that is EP)."""
+    repl = NamedSharding(mesh, P())
+
+    def blocks_spec(path_leaf):
+        return NamedSharding(mesh, P(pipe_axis)) if pipe_axis else repl
+
+    out = {
+        k: jax.tree.map(lambda _: repl, v)
+        for k, v in params.items()
+        if k != "blocks"
+    }
+    blocks = jax.tree.map(lambda _: blocks_spec(None), params["blocks"])
+    if expert_axis and "moe" in params["blocks"]:
+        # stacked moe leaves are [n_layers, E, ...]: expert dim is axis 1
+        blocks["moe"] = {
+            "router": repl,
+            "w_in": NamedSharding(mesh, P(pipe_axis, expert_axis, None, None)),
+            "w_out": NamedSharding(mesh, P(pipe_axis, expert_axis, None, None)),
+        }
+    out["blocks"] = blocks
+    return out
+
+
+def batch_shardings(mesh: Mesh, data_axis: str = "data"):
+    """Packed token batches shard their batch dim on the data axis."""
+    return {"tokens": NamedSharding(mesh, P(data_axis, None))}
+
+
+def make_synthetic_tokens(
+    cfg: LMConfig, batch_size: int, seed: int = 0, n_next: int = 4
+) -> np.ndarray:
+    """[B, L+1] int32 batches from a fixed sparse-bigram language: each
+    token has ``n_next`` plausible successors, so next-token CE can fall
+    from ~ln(V) toward ~ln(n_next) — training signal without real text."""
+    rng = np.random.default_rng(seed)
+    table = bigram_table(cfg.vocab_size, n_next, seed=1234)
+    out = np.empty((batch_size, cfg.max_len + 1), np.int32)
+    for i in range(batch_size):
+        t = int(rng.integers(cfg.vocab_size))
+        for j in range(cfg.max_len + 1):
+            out[i, j] = t
+            t = int(table[t, rng.integers(n_next)])
+    return out
+
+
+def bigram_table(vocab: int, n_next: int, seed: int = 1234) -> np.ndarray:
+    """[V, n_next] successor table — the synthetic 'language' shared by
+    tests, the example generator, and the bench probe."""
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, vocab, size=(vocab, n_next)).astype(np.int32)

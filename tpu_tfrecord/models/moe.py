@@ -7,25 +7,42 @@ mesh supports (dp/tp/sp are covered by models.dlrm and models.attention;
 pp by models.pipeline).
 
 TPU-first construction (the Switch-Transformer / Mesh-TensorFlow dispatch
-formulation, arXiv:2101.03961 §2.2):
-- top-1 routing with a FIXED per-expert capacity: every tensor keeps a
-  static shape, so the whole layer jits once and lands on the MXU as three
-  einsums (dispatch, expert FFN, combine) — no gather/scatter with
-  data-dependent shapes, no host round trips.
+formulation, arXiv:2101.03961 §2.2, top-k per GShard arXiv:2006.16668):
+- top-k routing (k=1 Switch default, k=2 the GShard/LM default) with a
+  FIXED per-expert capacity: every tensor keeps a static shape, so the
+  whole layer jits once and lands on the MXU as three einsums (dispatch,
+  expert FFN, combine) — no gather/scatter with data-dependent shapes, no
+  host round trips.
 - dispatch/combine are one-hot einsums: tokens beyond an expert's capacity
   contribute zero to the combine (dropped tokens ride the residual
-  connection — exactly the Switch behavior).
-- EP = the expert-indexed [E, ...] tensors sharded over a mesh axis via
-  NamedSharding; under jit, XLA inserts the collectives that move tokens
-  between the data and expert shardings per its cost model (all-to-all on
-  pod shapes, gather/reduce on small ones) — the role the torch
-  implementations hand-roll with NCCL alltoall. Expert weights never
-  replicate; that is what makes it EP.
+  connection — exactly the Switch behavior). Arrival order is rank-major:
+  every rank-0 (first-choice) assignment queues before any rank-1
+  assignment, then token order within a rank — the GShard "second-place
+  experts ride behind first-place" rule. Combine gates are the RAW router
+  probabilities of each chosen expert (no top-k renormalization), so
+  ``top_k=1`` reproduces the original Switch layer bit-for-bit.
+- two EP flavors:
+  * `moe_apply` — the auto-sharded layer: expert-indexed [E, ...] tensors
+    carry NamedShardings and XLA inserts whatever collectives its cost
+    model picks. Composable anywhere (models.long_doc uses it), but the
+    collective pattern is XLA's choice, not a contract.
+  * `moe_apply_ep` — the comms-PINNED layer: an explicit `shard_map` over
+    the expert axis with the token stream sharded on the same axis. Each
+    device routes its own tokens, `lax.all_to_all` exchanges the
+    dispatched capacity slices so every device runs ONLY its E/P experts,
+    and the inverse all_to_all brings expert outputs home for the local
+    combine. The compiled HLO contains `all-to-all` and NO `all-gather`
+    of tokens or expert weights — asserted by tests/hlo_util, the
+    contract `moe_apply` claims but cannot pin. Capacity is per
+    (expert, token-shard): each shard applies its own ceil(Tl·cf·k/E)
+    budget — the real distributed Switch semantics, mirrored exactly by
+    ``moe_reference(shards=P)``.
 - the router adds the standard load-balance auxiliary loss (mean fraction
-  * mean router prob per expert, scaled by E) so training spreads tokens.
+  of FIRST-choice assignments * mean router prob per expert, scaled by E)
+  so training spreads tokens.
 
-`moe_apply` is the layer; `moe_reference` is the per-token oracle used by
-the tests; `param_shardings` places the expert tensors on the EP axis.
+`moe_reference` is the per-token oracle used by the tests;
+`param_shardings` places the expert tensors on the EP axis.
 """
 
 from __future__ import annotations
@@ -37,15 +54,21 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_tfrecord.models._compat import shard_map
+
 
 @dataclass(frozen=True)
 class MoEConfig:
     d_model: int = 32
     d_ff: int = 64          # per-expert hidden width
     n_experts: int = 4
-    # capacity = ceil(tokens/expert * factor); 1.0 = perfectly balanced
-    # routing just fits, >1 gives slack before drops (Switch default 1.25)
+    # capacity = ceil(tokens * factor * top_k / n_experts); 1.0 =
+    # perfectly balanced routing just fits, >1 gives slack before drops
+    # (Switch default 1.25)
     capacity_factor: float = 1.25
+    # experts per token: 1 = Switch, 2 = GShard-style top-2 (second choice
+    # queues behind every first choice; raw-prob gates, no renorm)
+    top_k: int = 1
     dtype: Any = jnp.float32
 
 
@@ -75,7 +98,9 @@ def param_shardings(mesh: Mesh, expert_axis: str = "model") -> Dict[str, Any]:
 
 def _capacity(tokens: int, cfg: MoEConfig) -> int:
     # ceil, per the config contract: factor 1.0 must JUST FIT perfectly
-    # balanced routing (floor would drop tokens even when balanced).
+    # balanced routing (floor would drop tokens even when balanced); the
+    # top_k assignments per token scale the budget the same way GShard's
+    # 2N/E does.
     #
     # ``tokens`` is the STATIC flattened count INCLUDING padding, even
     # when ``moe_apply`` is given a ``valid`` mask (ADVICE r5 #3 — a
@@ -87,9 +112,99 @@ def _capacity(tokens: int, cfg: MoEConfig) -> int:
     # inflated, so FEWER tokens drop than factor implies, at the cost of
     # dispatch/combine tensors sized for the padded length. Callers
     # wanting a tighter match can shrink capacity_factor by their static
-    # worst-case valid fraction.
-    cap = -(-int(tokens * cfg.capacity_factor) // cfg.n_experts)
+    # worst-case valid fraction. Under ``moe_apply_ep`` the count is the
+    # per-shard token count: capacity is a per-(expert, shard) budget.
+    cap = -(-int(tokens * cfg.capacity_factor) * cfg.top_k // cfg.n_experts)
     return max(1, cap)
+
+
+def _route(probs: jax.Array, cfg: MoEConfig, c: int,
+           valid: Optional[jax.Array] = None):
+    """Shared top-k routing: probs [T, E] -> (dispatch [T, E, C],
+    combine [T, E, C], onehot0 [T, E] first-choice assignment).
+
+    Arrival order is rank-major (all rank-0 choices in token order, then
+    rank-1, ...): rank-k queue positions start after every lower rank's
+    TOTAL per-expert assignment count, so a flood of first choices can
+    push second choices past capacity but never vice versa."""
+    e = cfg.n_experts
+    if not (1 <= cfg.top_k <= e):
+        raise ValueError(
+            f"top_k must be in [1, n_experts={e}], got {cfg.top_k}"
+        )
+    masked = probs
+    prev_total = jnp.zeros((e,), jnp.float32)
+    dispatch = jnp.zeros(probs.shape + (c,), jnp.float32)
+    combine = jnp.zeros(probs.shape + (c,), jnp.float32)
+    onehot0 = None
+    for _ in range(cfg.top_k):
+        expert = jnp.argmax(masked, axis=-1)                    # [T]
+        gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)   # [T, E]
+        if valid is not None:
+            onehot = onehot * valid[:, None]  # padding: no expert, no slot
+            gate = gate * valid
+        # position of each token within its expert's queue (0-based),
+        # continuing after every lower rank's arrivals
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0 + prev_total[None, :]) * onehot
+        kept = (pos < c) & (onehot > 0)                         # [T, E]
+        pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), c, dtype=jnp.float32)
+        d_k = jnp.where(kept[..., None], pos_oh, 0.0)           # [T, E, C]
+        dispatch = dispatch + d_k
+        combine = combine + d_k * gate[:, None, None]
+        if onehot0 is None:
+            onehot0 = onehot
+        prev_total = prev_total + onehot.sum(axis=0)
+        # exclude this rank's pick from the next argmax
+        masked = jnp.where(onehot > 0, -jnp.inf, masked)
+    return dispatch, combine, onehot0
+
+
+def _expert_ffn(params: Dict[str, Any], expert_in: jax.Array, dt) -> jax.Array:
+    """[E, C, D] -> [E, C, D] through each expert's gelu FFN (einsum dims
+    are expert-local, so the same code serves the dense and EP bodies)."""
+    h = jax.nn.gelu(
+        jnp.einsum("ecd,edf->ecf", expert_in, params["w_in"].astype(dt))
+    )
+    return jnp.einsum("ecf,efd->ecd", h, params["w_out"].astype(dt))
+
+
+def _moe_local(params, xt, cfg: MoEConfig, valid_flat, *, c: int,
+               exchange=None):
+    """Route + dispatch + FFN + combine over ONE token shard — the ONE
+    per-shard body both flavors share. Returns (y [T, D], aux numerator
+    pieces): the caller owns how the aux-loss sums reduce (locally for
+    the dense layer, psum for the EP layer). ``exchange`` is an optional
+    (to_experts, from_experts) pair wrapped around the expert FFN —
+    identity for the dense layer, the all_to_all pair for EP."""
+    logits = xt.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                    # [T, E]
+    dispatch, combine, onehot0 = _route(probs, cfg, c, valid_flat)
+    if valid_flat is not None:
+        n_tokens = valid_flat.sum()
+        probs_for_aux = probs * valid_flat[:, None]
+    else:
+        n_tokens = jnp.float32(xt.shape[0])
+        probs_for_aux = probs
+    assign_sum = onehot0.sum(axis=0)                           # [E]
+    prob_sum = probs_for_aux.sum(axis=0)                       # [E]
+
+    dt = cfg.dtype
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(dt), xt.astype(dt))
+    if exchange is not None:
+        expert_in = exchange[0](expert_in)
+    expert_out = _expert_ffn(params, expert_in, dt)
+    if exchange is not None:
+        expert_out = exchange[1](expert_out)
+    y = jnp.einsum("tec,ecd->td", combine.astype(dt), expert_out)
+    return y, (assign_sum, prob_sum, n_tokens)
+
+
+def _aux_loss(assign_sum, prob_sum, n_tokens, e: int) -> jax.Array:
+    # load-balance aux loss (Switch eq. 4): E * mean(frac_tokens *
+    # mean_prob), fractions over FIRST-choice assignments and VALID tokens
+    n = jnp.maximum(n_tokens, 1.0)
+    return ((assign_sum / n) * (prob_sum / n)).sum() * e
 
 
 def moe_apply(
@@ -98,9 +213,12 @@ def moe_apply(
     cfg: MoEConfig,
     valid: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Top-1 MoE FFN. x: [..., T, D] (leading dims flattened internally).
-    Returns (y, aux_loss) with y.shape == x.shape; dropped tokens yield 0
-    (add the residual outside). All shapes static — jits once.
+    """Top-k MoE FFN, auto-sharded flavor. x: [..., T, D] (leading dims
+    flattened internally). Returns (y, aux_loss) with y.shape == x.shape;
+    dropped tokens yield 0 (add the residual outside). All shapes static —
+    jits once. EP comes from `param_shardings` on the [E, ...] tensors;
+    the collective pattern is XLA's pick (use `moe_apply_ep` when the
+    all-to-all must be a contract).
 
     ``valid``: optional boolean mask shaped like x without the feature dim
     ([..., T]). Invalid (padding) tokens are excluded ENTIRELY: they get
@@ -111,44 +229,115 @@ def moe_apply(
     orig_shape = x.shape
     d = orig_shape[-1]
     xt = x.reshape(-1, d)                                     # [T, D]
-    t = xt.shape[0]
-    e = cfg.n_experts
-    c = _capacity(t, cfg)
-
-    logits = xt.astype(jnp.float32) @ params["router"].astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)                    # [T, E]
-    expert = jnp.argmax(probs, axis=-1)                        # [T]
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]  # [T]
-
-    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)      # [T, E]
-    if valid is not None:
-        vt = valid.reshape(-1).astype(jnp.float32)             # [T]
-        onehot = onehot * vt[:, None]   # padding: no expert, no capacity
-        gate = gate * vt
-        n_tokens = jnp.maximum(vt.sum(), 1.0)
-        probs_for_aux = probs * vt[:, None]
-    else:
-        n_tokens = jnp.float32(t)
-        probs_for_aux = probs
-    # position of each token within its expert's queue (0-based)
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0            # [T, E]
-    kept = (pos < c) & (onehot > 0)                            # [T, E]
-    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), c, dtype=jnp.float32)
-    dispatch = jnp.where(kept[..., None], pos_oh, 0.0)         # [T, E, C]
-    combine = dispatch * gate[:, None, None]                   # [T, E, C]
-
-    # load-balance aux loss (Switch eq. 4): E * mean(frac_tokens * mean_prob)
-    # — means over VALID tokens only
-    frac = onehot.sum(axis=0) / n_tokens                       # [E]
-    mean_prob = probs_for_aux.sum(axis=0) / n_tokens           # [E]
-    aux = (frac * mean_prob).sum() * e
-
-    dt = cfg.dtype
-    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(dt), xt.astype(dt))
-    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, params["w_in"].astype(dt)))
-    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_out"].astype(dt))
-    y = jnp.einsum("tec,ecd->td", combine.astype(dt), expert_out)
+    c = _capacity(xt.shape[0], cfg)
+    valid_flat = (
+        valid.reshape(-1).astype(jnp.float32) if valid is not None else None
+    )
+    y, (assign_sum, prob_sum, n_tokens) = _moe_local(
+        params, xt, cfg, valid_flat, c=c
+    )
+    aux = _aux_loss(assign_sum, prob_sum, n_tokens, cfg.n_experts)
     return y.reshape(orig_shape).astype(x.dtype), aux.astype(jnp.float32)
+
+
+def moe_apply_ep(
+    params: Dict[str, Any],
+    x: jax.Array,
+    cfg: MoEConfig,
+    mesh: Mesh,
+    expert_axis: str = "expert",
+    data_axis: Optional[str] = None,
+    valid: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Comms-pinned EP flavor: explicit shard_map over ``expert_axis``
+    with the TOKEN dim sharded on the same axis.
+
+    x: [..., T, D] with T divisible by the expert-axis size P (and
+    n_experts % P == 0). Each device routes its own T/P tokens under a
+    per-shard capacity, one `lax.all_to_all` scatters the dispatched
+    [E, C, D] capacity slices so device p computes ONLY its E/P experts
+    over the P·C slots it received, and the inverse all_to_all returns
+    expert outputs for the local combine. Expert weights and tokens never
+    gather — per-device memory is the shard (E/P experts + T/P tokens +
+    the exchanged capacity slices) and the HLO contains `all-to-all`, no
+    `all-gather` (pinned by tests). Pass ``data_axis`` to keep leading
+    batch dims sharded as well. Numerics == `moe_reference(shards=P)`.
+    """
+    p = mesh.shape[expert_axis]
+    e = cfg.n_experts
+    if e % p:
+        raise ValueError(
+            f"moe_apply_ep needs n_experts % mesh['{expert_axis}'] == 0 "
+            f"(got E={e}, axis size {p})"
+        )
+    t_dim = x.shape[-2]
+    if t_dim % p:
+        raise ValueError(
+            f"moe_apply_ep needs the token dim % mesh['{expert_axis}'] == 0 "
+            f"(got T={t_dim}, axis size {p}); pad or re-bucket the stream"
+        )
+    # per-shard token count is static: the local capacity budget
+    lead = x.shape[:-2]
+    dp = (data_axis,) if data_axis is not None and lead else ()
+    x_spec = P(*dp, *([None] * (len(lead) - len(dp))), expert_axis, None)
+    v_spec = P(*dp, *([None] * (len(lead) - len(dp))), expert_axis)
+    t_local = t_dim // p
+    batch_local = 1
+    for dim, ax in zip(lead, (dp + (None,) * len(lead))[: len(lead)]):
+        batch_local *= dim // (mesh.shape[ax] if ax else 1)
+    c = _capacity(batch_local * t_local, cfg)
+
+    def body(params_l, x_l, valid_l=None):
+        xt = x_l.reshape(-1, x_l.shape[-1])
+        vf = (
+            valid_l.reshape(-1).astype(jnp.float32)
+            if valid_l is not None else None
+        )
+        # THE exchange around the shared per-shard body: slice the expert
+        # dim P ways, every device keeps its E/P experts and receives the
+        # matching [E, C, D] capacity slices from all peers (concat on the
+        # capacity dim -> [E/P, P*C, D]); the inverse brings expert
+        # outputs back to the token-owning device — tokens move, weights
+        # never do
+        exchange = (
+            lambda a: jax.lax.all_to_all(
+                a, expert_axis, split_axis=0, concat_axis=1, tiled=True
+            ),
+            lambda a: jax.lax.all_to_all(
+                a, expert_axis, split_axis=1, concat_axis=0, tiled=True
+            ),
+        )
+        y, (assign_sum, prob_sum, n_tok) = _moe_local(
+            params_l, xt, cfg, vf, c=c, exchange=exchange
+        )
+        # aux loss over the GLOBAL token stream: tiny [E] reductions
+        axes = (expert_axis,) + ((data_axis,) if data_axis else ())
+        aux = _aux_loss(
+            jax.lax.psum(assign_sum, axes),
+            jax.lax.psum(prob_sum, axes),
+            jax.lax.psum(n_tok, axes),
+            e,
+        )
+        return (
+            y.reshape(x_l.shape).astype(x_l.dtype), aux.astype(jnp.float32)
+        )
+
+    w_spec = {
+        "router": P(),
+        "w_in": P(expert_axis, None, None),
+        "w_out": P(expert_axis, None, None),
+    }
+    if valid is None:
+        fn = shard_map(
+            body, mesh=mesh, in_specs=(w_spec, x_spec),
+            out_specs=(x_spec, P()),
+        )
+        return fn(params, x)
+    fn = shard_map(
+        body, mesh=mesh, in_specs=(w_spec, x_spec, v_spec),
+        out_specs=(x_spec, P()),
+    )
+    return fn(params, x, valid)
 
 
 def moe_reference(
@@ -156,11 +345,15 @@ def moe_reference(
     x: jax.Array,
     cfg: MoEConfig,
     valid: Optional[Any] = None,
-) -> jax.Array:
-    """Per-token oracle: route each token to its argmax expert's FFN, gate
-    by the router prob, drop tokens beyond capacity in arrival order;
-    invalid tokens (``valid`` mask) are skipped entirely —
-    definitionally what moe_apply's einsum dance computes."""
+    shards: int = 1,
+) -> Any:
+    """Per-token oracle: route each token to its top-k experts' FFNs
+    (rank-major arrival: every first choice queues before any second
+    choice), gate by the raw router prob, drop assignments beyond
+    capacity; invalid tokens (``valid`` mask) are skipped entirely —
+    definitionally what the einsum dance computes. ``shards`` splits the
+    flat token stream into P contiguous blocks with INDEPENDENT per-block
+    capacity budgets — the `moe_apply_ep` distributed semantics."""
     import numpy as np
 
     xt = np.asarray(x, dtype=np.float64).reshape(-1, x.shape[-1])
@@ -172,21 +365,32 @@ def moe_reference(
     w_in = np.asarray(params["w_in"], dtype=np.float64)
     w_out = np.asarray(params["w_out"], dtype=np.float64)
     t = xt.shape[0]
-    cap = _capacity(t, cfg)
+    assert t % shards == 0, (t, shards)
+    t_l = t // shards
+    cap = _capacity(t_l, cfg)
     logits = xt @ router
     z = np.exp(logits - logits.max(axis=-1, keepdims=True))
     probs = z / z.sum(axis=-1, keepdims=True)
-    expert = probs.argmax(axis=-1)
-    counts = {ei: 0 for ei in range(cfg.n_experts)}
     out = np.zeros_like(xt)
-    for i in range(t):
-        if not vmask[i]:
-            continue
-        ei = int(expert[i])
-        if counts[ei] >= cap:
-            continue
-        counts[ei] += 1
-        h = xt[i] @ w_in[ei]
+
+    def ffn(ei, v):
+        h = v @ w_in[ei]
         h = 0.5 * h * (1.0 + np.tanh(np.sqrt(2 / np.pi) * (h + 0.044715 * h**3)))
-        out[i] = probs[i, ei] * (h @ w_out[ei])
+        return h @ w_out[ei]
+
+    for b in range(shards):
+        lo, hi = b * t_l, (b + 1) * t_l
+        counts = {ei: 0 for ei in range(cfg.n_experts)}
+        taken = [set() for _ in range(t_l)]  # experts already chosen per token
+        for rank in range(cfg.top_k):
+            for i in range(lo, hi):
+                if not vmask[i]:
+                    continue
+                order = np.argsort(-probs[i])
+                ei = next(int(e) for e in order if int(e) not in taken[i - lo])
+                taken[i - lo].add(ei)
+                if counts[ei] >= cap:
+                    continue
+                counts[ei] += 1
+                out[i] += probs[i, ei] * ffn(ei, xt[i])
     return out.reshape(x.shape)

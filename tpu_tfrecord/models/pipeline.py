@@ -1,4 +1,5 @@
-"""Pipeline parallelism (PP) over a mesh axis: GPipe-style microbatching.
+"""Pipeline parallelism (PP) over a mesh axis: GPipe-style microbatching,
+scale-shaped.
 
 The reference framework has no model-side parallelism (SURVEY.md §2) — this
 is the PP member of the consumer-model family, completing the dp/tp/sp/ep/pp
@@ -6,31 +7,54 @@ set the mesh design supports (dlrm: dp×tp×sp, attention: sp, moe: ep).
 
 TPU-idiomatic construction (the collective-permute pipeline from the
 public scaling playbook, jax-ml.github.io/scaling-book — NOT a torch-style
-send/recv scheduler):
+send/recv scheduler), rebuilt so every per-device quantity scales with the
+SHARD, not the global tensor (GSPMD's contract, PAPERS.md):
+
 - `shard_map` over the ``pipe`` axis; each device holds ONE stage's
   parameters (the stacked [S, ...] stage pytree is sharded on its leading
   dim, so stage weights never replicate — that is what makes it PP).
-- M microbatches flow through S stages in M + S - 1 ticks inside one
-  `lax.fori_loop` (static trip count → one compiled program, reverse-mode
-  differentiable via scan); activations hop device s -> s+1 with
-  `lax.ppermute` each tick, riding neighbor ICI links.
-- the classic bubble: S - 1 of the ticks per device are idle warmup/drain.
-  Efficiency = M / (M + S - 1) — callers pick M accordingly.
-- outputs accumulate on the last stage and replicate with one `psum`
-  (devices other than the last contribute zeros).
+- the microbatch tensor is SHARDED on the pipe axis too: device d holds
+  only its block of ceil(M/S) microbatches, never the full [M, mb, ...]
+  stream (the old construction replicated it to every stage, so per-device
+  input memory grew with M and defeated the point of pipelining).
+- the stream enters at stage 0 only, via a FEED RING: one microbatch slice
+  per device rotates one hop toward stage 0 each tick (`lax.ppermute`),
+  timed so microbatch t arrives at stage 0 exactly at tick t. In-flight
+  input per device is ONE [mb, ...] slice — O(mb), constant in M.
+- activations hop device s -> s+1 with `lax.ppermute` each tick; M
+  microbatches flow through S stages in M + S - 1 compute ticks inside one
+  `lax.fori_loop` (static trip count -> one compiled program, reverse-mode
+  differentiable via scan).
+- outputs are born on the LAST stage and ride an OUT RING (one more
+  O(mb) ppermute per tick) back to the device that owns that microbatch's
+  output shard — a targeted permute, not the old `psum` broadcast that
+  replicated the full [M, mb, ...] result to every device. A trailing
+  S - 1 permute-only drain delivers the final in-flight outputs without
+  extra stage compute.
+- the classic bubble is unchanged: S - 1 of the compute ticks per device
+  are idle warmup/drain. Efficiency = M / (M + S - 1) — callers pick M.
+
+Per-device totals: input ceil(M/S)·mb (the shard), loop state 3 slices +
+the output shard, collectives 3 ppermutes of ONE slice per tick. The
+compiled HLO therefore contains collective-permutes of microbatch-slice
+size only — no all-gather, no all-reduce — pinned by tests/hlo_util.
 
 `pipeline_apply` is the sharded entry point; `pipeline_reference` is the
-sequential oracle used by the tests.
+sequential oracle used by the tests. `microbatch_sharding` gives callers
+the input layout so the stream can be device_put straight into its shard
+(feeding the pipeline never materializes [M, mb, ...] anywhere).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_tfrecord.models._compat import shard_map
 
 StageFn = Callable[[Any, jax.Array], jax.Array]
 
@@ -49,39 +73,99 @@ def pipeline_reference(stage_fn: StageFn, stage_params: Any, xs: jax.Array) -> j
     return jax.vmap(one)(xs)
 
 
-def _pipeline_local(params_stk, xs, *, stage_fn: StageFn, n_micro: int, axis: str):
+def microbatch_sharding(
+    mesh: Mesh, pipe_axis: str = "pipe", ndim: int = 3,
+    batch_spec: P = P(),
+) -> NamedSharding:
+    """Input layout for ``pipeline_apply``: microbatch dim 0 sharded on the
+    pipe axis (device d holds its ceil(M/S) block), trailing dims per
+    ``batch_spec``. device_put the stream with this so no device ever
+    materializes the full [M, mb, ...] tensor. Needs M % S == 0 (pad the
+    stream first when it does not divide — `pipeline_apply` only pads
+    internally for inputs that arrive unsharded)."""
+    tail = tuple(batch_spec) + (None,) * (ndim - 1 - len(tuple(batch_spec)))
+    return NamedSharding(mesh, P(pipe_axis, *tail))
+
+
+def _pipeline_local(
+    params_stk, xs_local, *, stage_fn: StageFn, n_micro: int, n_stages: int,
+    block: int, axis: str,
+):
     """Per-device body (inside shard_map): params_stk is THIS stage's slice
-    (leading dim 1); xs is the full replicated [M, mb, ...] input."""
+    (leading dim 1); xs_local is THIS device's [R, mb, ...] block of the
+    microbatch stream (R = ceil(M/S); device d owns microbatches
+    [d*R, (d+1)*R)).
+
+    Three O(mb) rings, all ppermute:
+      feed ring (hop -1): device d injects its slice for microbatch m at
+        tick m - d, so it reaches stage 0 exactly at tick m. Invariant:
+        at tick t, device j's feed slot holds microbatch t + j.
+      activation ring (hop +1): stage s's output becomes stage s+1's input.
+      out ring (hop +1): the last stage injects each finished microbatch;
+        the owner (m // R) captures it ((m+1 thru S-1)-hop journey later)
+        into its output shard. Invariant: at tick t device j holds the
+        output injected at tick t - ((j+1) mod S).
+    """
     params = jax.tree.map(lambda a: a[0], params_stk)
     s = jax.lax.axis_index(axis)
-    n_stages = jax.lax.axis_size(axis)
-    perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
-    mb_shape = xs.shape[1:]
-    # the loop writes device-varying values into these, so their types must
-    # be pipe-varying from the start (xs is replicated -> unvarying)
-    carry0 = jax.lax.pcast(jnp.zeros(mb_shape, xs.dtype), (axis,), to="varying")
-    out0 = jax.lax.pcast(
-        jnp.zeros((n_micro,) + mb_shape, xs.dtype), (axis,), to="varying"
-    )
+    r_blk = block
+    mb_shape = xs_local.shape[1:]
+    fwd = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+    back = [(j, (j - 1) % n_stages) for j in range(n_stages)]
+    zero = jnp.zeros(mb_shape, xs_local.dtype)
+    feed0, act0, ring0 = zero, zero, zero
+    outbuf0 = jnp.zeros((r_blk,) + mb_shape, xs_local.dtype)
+
+    def capture(t, ring, outbuf):
+        # device j holds the output injected at tick t - ((j+1) mod S),
+        # i.e. microbatch  t - ((j+1) mod S) - (S-1); capture it iff j
+        # owns that microbatch's output shard
+        m_cap = t - jax.lax.rem(s + 1, n_stages) - (n_stages - 1)
+        cap = (m_cap >= 0) & (m_cap < n_micro) & (m_cap // r_blk == s)
+        slot = jnp.clip(m_cap - s * r_blk, 0, r_blk - 1)
+        got = jax.lax.dynamic_index_in_dim(outbuf, slot, keepdims=False)
+        return jax.lax.dynamic_update_index_in_dim(
+            outbuf, jnp.where(cap, ring, got), slot, axis=0
+        )
 
     def tick(t, state):
-        carry, outbuf = state
-        # stage 0 injects microbatch t (clipped reads past M compute
-        # garbage that the output mask below never collects)
-        inp = jnp.where(s == 0, xs[jnp.clip(t, 0, n_micro - 1)], carry)
-        out = stage_fn(params, inp)
-        m = t - (n_stages - 1)  # the microbatch the LAST stage just finished
-        write = (s == n_stages - 1) & (m >= 0)
-        mc = jnp.clip(m, 0, n_micro - 1)
-        outbuf = outbuf.at[mc].set(jnp.where(write, out, outbuf[mc]))
-        carry = jax.lax.ppermute(out, axis, perm)  # hop to the next stage
-        return carry, outbuf
+        feed, act, ring, outbuf = state
+        # feed ring: rotate toward stage 0, then inject this device's
+        # next owned slice (m = t + s) the moment its travel time is due
+        m_inj = t + s
+        inject = (m_inj < n_micro) & (m_inj // r_blk == s)
+        local_r = jnp.clip(m_inj - s * r_blk, 0, r_blk - 1)
+        mine = jax.lax.dynamic_index_in_dim(xs_local, local_r, keepdims=False)
+        feed = jnp.where(inject, mine, jax.lax.ppermute(feed, axis, back))
+        # stage compute: stage 0 eats the feed, everyone else the arriving
+        # activation (clipped reads past M compute garbage that the
+        # capture mask never collects)
+        out = stage_fn(params, jnp.where(s == 0, feed, act))
+        # out ring: rotate, last stage injects its finished microbatch
+        ring = jnp.where(
+            s == n_stages - 1, out, jax.lax.ppermute(ring, axis, fwd)
+        )
+        outbuf = capture(t, ring, outbuf)
+        act = jax.lax.ppermute(out, axis, fwd)  # hop to the next stage
+        return feed, act, ring, outbuf
 
-    _, outbuf = jax.lax.fori_loop(
-        0, n_micro + n_stages - 1, tick, (carry0, out0)
+    def drain(t, state):
+        # permute-only tail: the last S - 1 in-flight outputs finish their
+        # ring journey; no stage compute, no feed
+        ring, outbuf = state
+        ring = jax.lax.ppermute(ring, axis, fwd)
+        outbuf = capture(t, ring, outbuf)
+        return ring, outbuf
+
+    _, _, ring, outbuf = jax.lax.fori_loop(
+        0, n_micro + n_stages - 1, tick, (feed0, act0, ring0, outbuf0)
     )
-    # only the last stage wrote; psum replicates the result everywhere
-    return jax.lax.psum(outbuf, axis)
+    if n_stages > 1:
+        _, outbuf = jax.lax.fori_loop(
+            n_micro + n_stages - 1, n_micro + 2 * n_stages - 2, drain,
+            (ring, outbuf),
+        )
+    return outbuf
 
 
 def pipeline_apply(
@@ -90,6 +174,7 @@ def pipeline_apply(
     xs: jax.Array,
     mesh: Mesh,
     pipe_axis: str = "pipe",
+    batch_spec: P = P(),
 ) -> jax.Array:
     """Run M microbatches through S pipeline stages sharded on
     ``mesh[pipe_axis]``.
@@ -98,6 +183,17 @@ def pipeline_apply(
     every stage must map shape [mb, ...] -> [mb, ...] (same shape, so the
     activation hop is shape-stable). xs: [M, mb, ...]. Returns [M, mb, ...],
     bitwise the sequential composition (pinned by tests).
+
+    Scale shape: xs is consumed SHARDED on the pipe axis (block layout —
+    device d holds microbatches [d*R, (d+1)*R), R = ceil(M/S); see
+    `microbatch_sharding`), so per-device input is the shard, the in-flight
+    feed is one [mb, ...] slice, and every collective moves one slice.
+
+    ``batch_spec`` optionally shards the PER-MICROBATCH dims over further
+    mesh axes (e.g. ``P('data')`` to keep the mb dim data-parallel inside
+    the pipeline — the dp×pp composition); stage_fn then sees its
+    (pipe, data)-local block and may itself use collectives over those
+    axes, which are manual inside the same shard_map.
     """
     n_stages = mesh.shape[pipe_axis]
     leaves = jax.tree.leaves(stage_params)
@@ -109,12 +205,24 @@ def pipeline_apply(
             f"{bad or 'no leaves'}"
         )
     n_micro = xs.shape[0]
-    fn = jax.shard_map(
+    block = -(-n_micro // n_stages)  # ceil: each device's owned slice count
+    padded = block * n_stages
+    if padded != n_micro:
+        # pad the stream so the block layout divides; padded microbatches
+        # compute garbage the capture mask never collects
+        xs = jnp.concatenate(
+            [xs, jnp.zeros((padded - n_micro,) + xs.shape[1:], xs.dtype)]
+        )
+    tail = tuple(batch_spec) + (None,) * (xs.ndim - 1 - len(tuple(batch_spec)))
+    spec = P(pipe_axis, *tail)
+    fn = shard_map(
         functools.partial(
-            _pipeline_local, stage_fn=stage_fn, n_micro=n_micro, axis=pipe_axis
+            _pipeline_local, stage_fn=stage_fn, n_micro=n_micro,
+            n_stages=n_stages, block=block, axis=pipe_axis,
         ),
         mesh=mesh,
-        in_specs=(P(pipe_axis), P()),
-        out_specs=P(),
+        in_specs=(P(pipe_axis), spec),
+        out_specs=spec,
     )
-    return fn(stage_params, xs)
+    out = fn(stage_params, xs)
+    return out[:n_micro] if padded != n_micro else out

@@ -18,6 +18,7 @@ from tpu_tfrecord.tpu.bitpack import pack_bits, pack_mixed, packed_width, unpack
 from tpu_tfrecord.tpu.ingest import (
     DeviceIterator,
     HostPrefetcher,
+    TokenPacker,
     batch_spec,
     data_shardings,
     hash_bytes_column,
@@ -37,6 +38,7 @@ __all__ = [
     "hash_bytes_column",
     "DeviceIterator",
     "HostPrefetcher",
+    "TokenPacker",
     "pack_bits",
     "pack_mixed",
     "packed_width",

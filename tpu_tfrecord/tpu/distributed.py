@@ -19,6 +19,7 @@ parallelism table, §5). The TPU-native equivalents:
 from __future__ import annotations
 
 import json
+import os
 from typing import List, Optional
 
 import jax
@@ -36,6 +37,19 @@ def initialize(
     """Initialize multi-host JAX if needed; safe no-op when single-process."""
     if num_processes in (None, 1) and coordinator_address is None:
         return
+    # env-only check: probing jax.default_backend() would initialize the
+    # ambient backend, which hangs forever on a dead device tunnel
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower().startswith("cpu"):
+        # CPU fleets need an explicit cross-process collectives impl:
+        # without it, a computation spanning processes dies with
+        # "Multiprocess computations aren't implemented on the CPU
+        # backend" the moment no process holds a whole replica (e.g. the
+        # 2-process x 1-device dryrun). Must be set BEFORE the backend
+        # client is created; harmless when already initialized.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # older/newer jax without the knob: keep prior behavior
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

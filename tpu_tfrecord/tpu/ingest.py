@@ -370,6 +370,97 @@ def make_global_batch(
     return out
 
 
+class TokenPacker:
+    """Ragged token documents -> packed causal-LM batches [B, L+1] int32.
+
+    Documents are concatenated with an EOS separator and sliced into
+    non-overlapping windows of L+1 tokens (the consumer reads
+    ``row[:-1]`` and scores against ``row[1:]``), so every batch is fully
+    dense — no padding, no masks, maximal MXU utilization — the standard
+    packed-LM feed. The window boundary drops no tokens: the residual
+    tail carries into the next batch.
+
+    The carry (residual tokens + any already-packed-but-unpopped rows) is
+    the ONLY state, exposed via ``state()``/``restore()`` as a small JSON
+    payload, so a training job checkpoints it NEXT TO the dataset's
+    `IteratorState` and a kill -9/resume replays the packed stream
+    byte-identically (pinned by examples/train_lm.py's harness test).
+    """
+
+    def __init__(self, batch_size: int, seq_len: int, eos_id: int = 0):
+        if batch_size < 1 or seq_len < 1:
+            raise ValueError(
+                f"batch_size and seq_len must be >= 1, got "
+                f"({batch_size}, {seq_len})"
+            )
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.eos_id = int(eos_id)
+        self._buf: List[np.ndarray] = []   # chunks, flattened lazily
+        self._buf_len = 0
+        self._pending: List[np.ndarray] = []  # ready [B, L+1] batches
+
+    def feed_docs(self, docs: Iterable[np.ndarray]) -> None:
+        """Append documents (1-D int arrays) to the stream, EOS after each."""
+        eos = np.asarray([self.eos_id], np.int32)
+        for doc in docs:
+            arr = np.asarray(doc).astype(np.int32, copy=False).reshape(-1)
+            self._buf.append(arr)
+            self._buf.append(eos)
+            self._buf_len += arr.size + 1
+        self._drain()
+
+    def feed_column(self, col) -> None:
+        """Feed a ragged int Column straight from a ColumnarBatch: the
+        flat values/offsets ARE the document boundaries."""
+        values = np.asarray(col.values)
+        offsets = np.asarray(col.offsets)
+        self.feed_docs(
+            values[offsets[i] : offsets[i + 1]]
+            for i in range(len(offsets) - 1)
+        )
+
+    def _drain(self) -> None:
+        need = self.batch_size * (self.seq_len + 1)
+        if self._buf_len < need:
+            return
+        flat = np.concatenate(self._buf) if len(self._buf) > 1 else self._buf[0]
+        n_batches = flat.size // need
+        take = n_batches * need
+        for i in range(n_batches):
+            self._pending.append(
+                flat[i * need : (i + 1) * need]
+                .reshape(self.batch_size, self.seq_len + 1)
+                .copy()
+            )
+        rest = flat[take:]
+        self._buf = [rest] if rest.size else []
+        self._buf_len = int(rest.size)
+
+    def pop(self) -> Optional[np.ndarray]:
+        """Next ready [B, L+1] batch, or None when more docs are needed."""
+        return self._pending.pop(0) if self._pending else None
+
+    def state(self) -> dict:
+        """JSON-able carry: checkpoint it WITH the dataset IteratorState
+        taken at the same point so resume replays byte-identically."""
+        flat = (
+            np.concatenate(self._buf).tolist() if self._buf else []
+        )
+        return {
+            "residual": flat,
+            "pending": [b.tolist() for b in self._pending],
+        }
+
+    def restore(self, state: dict) -> None:
+        residual = np.asarray(state.get("residual", []), np.int32)
+        self._buf = [residual] if residual.size else []
+        self._buf_len = int(residual.size)
+        self._pending = [
+            np.asarray(b, np.int32) for b in state.get("pending", [])
+        ]
+
+
 class HostPrefetcher:
     """Run a host-batch iterator in a background thread behind a bounded
     queue.
