@@ -24,6 +24,7 @@ import json
 import os
 import sys
 import time
+from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -1019,6 +1020,238 @@ def _service_probe(data_dir, schema, hash_buckets, pack) -> dict:
         d.stop()
 
 
+def _elastic_probe() -> dict:
+    """Elastic decode fleet leg (ISSUE 12): worker count vs offered load.
+    A dedicated small dataset is served through the data service while
+    every worker-side read pays a seeded 10ms injected stall — one worker
+    cannot keep the consumer fed, the consumer's spool says
+    producer_bound, and the FleetScaler must GROW the fleet; when the
+    consumer closes (load removed, its spool lands a final snapshot) the
+    verdict goes idle and the scaler must DRAIN back toward the floor.
+    Reports ``elastic_value`` (examples/s through the elastic fleet) plus
+    the workers-vs-time load table and the scaler's decision trajectory.
+    Device-free by construction: runs in the pre-backend-init block, so a
+    dead tunnel still certifies the elastic layer."""
+    import tempfile
+
+    import tpu_tfrecord.io as tfio
+    from tpu_tfrecord import elastic, service
+    from tpu_tfrecord.faults import FaultPlan, FaultRule, install_chaos
+    from tpu_tfrecord.io.dataset import TFRecordDataset
+    from tpu_tfrecord.metrics import METRICS
+    from tpu_tfrecord.schema import LongType, StructField, StructType
+
+    seconds = float(os.environ.get("TFR_BENCH_ELASTIC_SECONDS", 6.0))
+    root = tempfile.mkdtemp(prefix="tfr_bench_elastic_")
+    out_dir = os.path.join(root, "ds")
+    schema = StructType([StructField("id", LongType(), nullable=False)])
+    for s in range(6):
+        tfio.write([[i] for i in range(s * 2000, (s + 1) * 2000)], schema,
+                   out_dir, mode="append" if s else "overwrite")
+    spool = os.path.join(root, "spool")
+    ups0 = METRICS.counter("elastic.scale_ups")
+    downs0 = METRICS.counter("elastic.scale_downs")
+    drains0 = METRICS.counter("elastic.drains")
+    d = service.ServiceDispatcher(lease_ttl_s=2.0).start()
+    workers = []
+
+    def spawn():
+        workers.append(
+            service.DecodeWorker(d.addr, drain_grace_s=0.2).start()
+        )
+
+    scaler = elastic.FleetScaler(
+        d, spawn, spool_dir=spool,
+        policy=elastic.ScalerPolicy(
+            hysteresis=2, cooldown_s=0.5, min_workers=1, max_workers=3
+        ),
+        interval_s=0.25,
+    ).start()
+    plan = FaultPlan(
+        [FaultRule(op="read", kind="stall", path="part-", times=None,
+                   stall_ms=10)],
+        seed=5,
+    )
+    samples = []  # (elapsed_s, active_workers): the load table
+    n = 0
+    try:
+        with install_chaos(plan):
+            ds = TFRecordDataset(
+                out_dir, batch_size=256, schema=schema, num_epochs=None,
+                service=d.addr, service_deadline_ms=15000,
+                telemetry_spool_dir=spool, spool_interval_s=0.1,
+            )
+            t0 = time.perf_counter()
+            with ds.batches() as it:
+                for b in it:
+                    n += b.num_rows
+                    el = time.perf_counter() - t0
+                    if not samples or el - samples[-1][0] >= 0.5:
+                        samples.append((
+                            round(el, 2),
+                            int(METRICS.gauge_value("elastic.workers", 1) or 1),
+                        ))
+                    if el >= seconds:
+                        break
+            value = n / (time.perf_counter() - t0)
+        plan.release()
+        peak = max((w for _t, w in samples), default=1)
+        # load removed: the consumer's spool said goodbye (final), the
+        # verdict goes idle, and the fleet must shrink toward the floor
+        deadline = time.perf_counter() + 10.0
+        after = peak
+        while time.perf_counter() < deadline:
+            st = d.status()
+            after = sum(
+                1 for w in st["workers"]
+                if w["alive"] and not w["draining"]
+            )
+            if after <= 1:
+                break
+            time.sleep(0.2)
+        return {
+            "elastic_value": round(value, 1),
+            "elastic": {
+                "seconds": seconds,
+                "workers_start": 1,
+                "workers_peak": peak,
+                "workers_after_load_removed": after,
+                "scale_ups": METRICS.counter("elastic.scale_ups") - ups0,
+                "scale_downs": METRICS.counter("elastic.scale_downs") - downs0,
+                "drains_completed": METRICS.counter("elastic.drains") - drains0,
+                "load_table": samples,
+                "trajectory": scaler.log[:32],
+            },
+        }
+    finally:
+        scaler.stop()
+        for w in workers:
+            w.stop()
+        d.stop()
+
+
+def _decode_scaling_trend(data_dir, schema, hash_buckets, pack) -> dict:
+    """Workers -> ex/s sweep, committed to PARITY.md every round (ROADMAP
+    #1 / VERDICT #8): one round's scaling sample is an anecdote; the
+    appended table is the TREND multi-core extrapolations need. Each
+    point is the same device-free host loop host_side_value uses, at
+    num_workers = 1/2/4. Runs pre-backend."""
+    secs = float(os.environ.get("TFR_BENCH_SCALING_SECONDS", 1.5))
+    series = {}
+    for w in (1, 2, 4):
+        series[w] = round(_host_side_throughput(
+            data_dir, schema, hash_buckets, pack, seconds=secs,
+            num_workers=w,
+        ), 1)
+    try:
+        _append_parity_scaling_row(series)
+    except Exception as e:  # noqa: BLE001 — a malformed/hand-edited
+        # PARITY.md must cost the trend row, never the bench artifact
+        print(f"bench: PARITY.md decode-scaling append failed: {e}",
+              file=sys.stderr, flush=True)
+    return {"decode_scaling_ex_s": {str(k): v for k, v in series.items()}}
+
+
+_PARITY_SCALING_HEADER = "## Decode-scaling trend (bench-appended)"
+
+
+def _append_parity_scaling_row(series: dict, path: Optional[str] = None) -> None:
+    """Append one round's workers->ex/s row under the trend table in
+    PARITY.md (creating the section on first use). Rows are inserted at
+    the end of the section, before any later section. ``path`` overrides
+    the repo PARITY.md (test seam)."""
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    parity = path or os.path.join(here, "PARITY.md")
+    rounds = [
+        int(m.group(1))
+        for name in os.listdir(here)
+        for m in [re.match(r"BENCH_r(\d+)\.json$", name)]
+        if m
+    ]
+    label = f"r{(max(rounds) + 1 if rounds else 1):02d}"
+    date = time.strftime("%Y-%m-%d")
+    row = (
+        f"| {label} | {date} | {series[1]:.0f} | {series[2]:.0f} "
+        f"| {series[4]:.0f} | {series[2] / series[1]:.2f}x "
+        f"| {series[4] / series[1]:.2f}x |"
+    )
+    with open(parity) as fh:
+        content = fh.read()
+    if _PARITY_SCALING_HEADER not in content:
+        block = (
+            f"\n{_PARITY_SCALING_HEADER}\n\n"
+            "One row per bench round (appended by `bench.py`, device-free,\n"
+            "pre-backend): sustained decode throughput of the Criteo-shaped\n"
+            "host loop at num_workers = 1/2/4 on the round's box. On the\n"
+            "2-vCPU bench box ratios ~<=1 are the documented contention\n"
+            "negative control (PARITY round 7); the trend is what multi-core\n"
+            "extrapolations should be anchored to.\n\n"
+            "| round | date | 1w ex/s | 2w ex/s | 4w ex/s | 2w/1w | 4w/1w |\n"
+            "|---|---|---|---|---|---|---|\n"
+            f"{row}\n"
+        )
+        content = content.rstrip("\n") + "\n" + block
+    else:
+        head, _, tail = content.partition(_PARITY_SCALING_HEADER)
+        # the section runs to the next "## " heading (or EOF); the new
+        # row lands right after the LAST table row, so trailing prose
+        # (the basis-row footnote) stays below the table
+        m = re.search(r"\n## ", tail)
+        if m is None:
+            section, rest = tail, ""
+        else:
+            section, rest = tail[: m.start()], tail[m.start():]
+        lines = section.split("\n")
+        # insert after the last table line of ANY kind — data row, the
+        # "|---|" separator, or the header — so a table stripped down to
+        # header+separator gets its new row BELOW the separator, never
+        # wedged between header and separator
+        rows = [i for i, line in enumerate(lines) if line.startswith("|")]
+        if rows:
+            lines.insert(rows[-1] + 1, row)
+        else:
+            # header survived a hand edit but the table didn't: rebuild
+            # the table head in place rather than dying row-less
+            lines.extend([
+                "",
+                "| round | date | 1w ex/s | 2w ex/s | 4w ex/s | 2w/1w | 4w/1w |",
+                "|---|---|---|---|---|---|---|",
+                row,
+            ])
+        content = head + _PARITY_SCALING_HEADER + "\n".join(lines) + rest
+    with open(parity, "w") as fh:
+        fh.write(content)
+
+
+def _attach_regression_verdict(out: dict) -> None:
+    """vs_previous + the FIRST-CLASS ``regression_verdict`` (ROADMAP #1):
+    a banded-field drop is a loud top-level verdict plus a nonzero stderr
+    line, never just a buried list a reader has to know to look for.
+    Attached on every artifact path — success and both degraded shapes —
+    so an rc!=0 round still self-flags."""
+    vs_prev = _vs_previous(out)
+    if vs_prev is not None:
+        out["vs_previous"] = vs_prev
+    regressions = (vs_prev or {}).get("regressions") or []
+    out["regression_verdict"] = (
+        "no_previous" if vs_prev is None
+        else ("regression" if regressions else "ok")
+    )
+    if regressions:
+        fields = vs_prev["fields"]
+        print(
+            "bench REGRESSION vs " + vs_prev["previous_round"] + ": "
+            + ", ".join(
+                f"{f} {fields[f]['previous']} -> {fields[f]['current']} "
+                f"({fields[f]['delta_pct']:+}%)"
+                for f in regressions
+            ),
+            file=sys.stderr, flush=True,
+        )
+
+
 def _model_parallel_child() -> None:
     """Subprocess body (CPU 8-device env forced by the parent): measure the
     model-parallel memory shape + a causal-LM train rate, print ONE JSON
@@ -1176,6 +1409,9 @@ _PREV_NOISE_BANDS = {
     "remote_http_cached_value": 0.35,
     "seq_host_value": 0.25,
     "service_value": 0.25,
+    # elastic leg: throttled-decode throughput through a resizing fleet —
+    # wide band, the injected stalls + scaling transient dominate
+    "elastic_value": 0.50,
     "warm_epoch_value": 0.25,
     "cold_value": 0.50,
     "value": 0.35,
@@ -1356,6 +1592,16 @@ def main() -> None:
             service_info["service"]["vs_host_side"] = round(
                 service_info["service_value"] / host_side_value, 3
             )
+    elastic_info = None
+    if os.environ.get("TFR_BENCH_ELASTIC", "1") != "0":
+        # elastic decode fleet: worker count tracks offered load, drains
+        # on load removal (~16s, device-free) — ISSUE 12
+        elastic_info = _elastic_probe()
+    scaling_info = None
+    if os.environ.get("TFR_BENCH_SCALING", "1") != "0":
+        # workers->ex/s sweep, appended to PARITY.md as the round trend
+        # (~6s, device-free)
+        scaling_info = _decode_scaling_trend(data_dir, schema, hash_buckets, pack)
     model_parallel_info = None
     if os.environ.get("TFR_BENCH_MODEL", "1") != "0":
         # model-parallel memory shape + LM train rate in a CPU-forced
@@ -1395,12 +1641,10 @@ def main() -> None:
             for extra in (cold_info, remote_info, remote_http_info,
                           stall_info, warm_info, telemetry_info,
                           seq_host_info, autotune_info, service_info,
-                          model_parallel_info):
+                          elastic_info, scaling_info, model_parallel_info):
                 if extra is not None:
                     out.update(extra)
-            vs_prev = _vs_previous(out)
-            if vs_prev is not None:
-                out["vs_previous"] = vs_prev
+            _attach_regression_verdict(out)
             print(json.dumps(out), flush=True)
             os._exit(0)
         err = {
@@ -1413,12 +1657,10 @@ def main() -> None:
         for extra in (cold_info, remote_info, remote_http_info,
                       stall_info, warm_info, telemetry_info,
                       seq_host_info, autotune_info, service_info,
-                      model_parallel_info):
+                      elastic_info, scaling_info, model_parallel_info):
             if extra is not None:
                 err.update(extra)
-        vs_prev = _vs_previous(err)
-        if vs_prev is not None:
-            err["vs_previous"] = vs_prev
+        _attach_regression_verdict(err)
         print(json.dumps(err), flush=True)
         # exit 0: the artifact carries valid host-side metrics plus the
         # structured `error` field — the perf harness records the run
@@ -1809,6 +2051,13 @@ def main() -> None:
         # disaggregated data service leg: K worker subprocesses -> 1
         # consumer vs host_side_value (TFR_BENCH_SERVICE=1)
         out.update(service_info)
+    if elastic_info is not None:
+        # elastic fleet: worker count vs offered load + drain-back
+        # (TFR_BENCH_ELASTIC=1)
+        out.update(elastic_info)
+    if scaling_info is not None:
+        # workers->ex/s sweep (also appended to PARITY.md as the trend)
+        out.update(scaling_info)
     if model_parallel_info is not None:
         # model-parallel memory shape (per-device pipeline input bytes,
         # old replicated vs new O(mb) shard) + LM train rate
@@ -1825,10 +2074,9 @@ def main() -> None:
         # the BASELINE.md >=95% target metric, measured in its own regime
         # (device step >= host batch time by model size)
         out["duty_cycle_heavy"] = round(heavy_duty, 4)
-    vs_prev = _vs_previous(out)
-    if vs_prev is not None:
-        # self-flagging regression check vs the previous round's artifact
-        out["vs_previous"] = vs_prev
+    # self-flagging regression check vs the previous round's artifact,
+    # with the first-class top-level verdict + loud stderr line
+    _attach_regression_verdict(out)
     run_done.set()
     print(json.dumps(out))
 

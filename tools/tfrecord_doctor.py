@@ -625,10 +625,14 @@ def fleet_main(argv: List[str]) -> int:
 def serve_status_main(argv: List[str]) -> int:
     """The ``serve-status`` subcommand: one status round trip to a data
     service dispatcher (tpu_tfrecord.service) — one ``worker`` line per
-    registered worker (liveness, current leases, shards done, heartbeat
-    age; the fleet doctor's per-proc rendering vocabulary) and one
-    ``service`` summary line. Exit 0 = report produced (dead workers are a
-    finding, not a failure); 2 = dispatcher unreachable or not a
+    registered worker (liveness, draining flag, current leases, shards
+    done, heartbeat age; the fleet doctor's per-proc rendering
+    vocabulary), one ``tenant`` line per decode fingerprint (consumers /
+    jobs / leases / warm-cache hit ratio — the multi-tenant sharing
+    picture), a ``scaler`` line when an elastic FleetScaler is attached
+    (current workers, last decision + reason, drain list), and one
+    ``service`` summary line. Exit 0 = report produced (dead workers are
+    a finding, not a failure); 2 = dispatcher unreachable or not a
     dispatcher."""
     ap = argparse.ArgumentParser(
         prog="tfrecord_doctor serve-status",
@@ -665,15 +669,50 @@ def serve_status_main(argv: List[str]) -> int:
             "addr": w["addr"],
             "pid": w["pid"],
             "alive": w["alive"],
+            "draining": w.get("draining", False),
             "heartbeat_age_s": w["heartbeat_age_s"],
             "leases": w["leases"],
             "shards_done": w["shards_done"],
+        })
+    # one line per tenant (decode fingerprint): who shares this lease
+    # table, and how much of its work the warm cache absorbed
+    for t, info in sorted(status.get("tenants", {}).items()):
+        completions = info.get("completions", 0)
+        emit({
+            "event": "tenant",
+            "tenant": t,
+            "consumers": info.get("consumers", 0),
+            "jobs": info.get("jobs", 0),
+            "leases": info.get("leases", 0),
+            "shards_done": info.get("shards_done", 0),
+            "completions": completions,
+            "shared_cache_hits": info.get("shared_cache_hits", 0),
+            "cache_hit_ratio": (
+                round(info.get("shared_cache_hits", 0) / completions, 3)
+                if completions else None
+            ),
+        })
+    scaler = status.get("scaler")
+    if scaler is not None:
+        emit({
+            "event": "scaler",
+            "workers": scaler.get("workers"),
+            "min_workers": scaler.get("min_workers"),
+            "max_workers": scaler.get("max_workers"),
+            "draining": scaler.get("draining", []),
+            "verdict": scaler.get("verdict"),
+            "last_decision": scaler.get("last_decision"),
+            "scale_ups": scaler.get("scale_ups", 0),
+            "scale_downs": scaler.get("scale_downs", 0),
+            "drains_completed": scaler.get("drains_completed", 0),
         })
     emit({
         "event": "service",
         "path": args.dispatcher,
         "workers": len(status.get("workers", [])),
         "alive": status.get("alive", 0),
+        "draining": status.get("draining", []),
+        "tenants": len(status.get("tenants", {})),
         "dead": [
             {"worker_id": w["worker_id"], "addr": w["addr"],
              "heartbeat_age_s": w["heartbeat_age_s"]}

@@ -425,6 +425,109 @@ print("service smoke OK:", json.dumps({
 }))
 PY
 
+echo "== elastic smoke (throttled fleet grows -> drains on idle -> identical rows) =="
+# The elastic service layer end-to-end, production-shaped: the FleetScaler
+# brings up ONE decode-worker subprocess (below-min refill), every worker
+# read pays a seeded 25ms injected stall (--fault-plan), so the consumer's
+# spool says producer_bound and the scaler must GROW the fleet mid-run;
+# when the consumer closes (load removed) the verdict goes idle and the
+# scaler must DRAIN back to 1 worker via clean goodbyes. Rows must be
+# byte-identical throughout, and serve-status (with its new tenant +
+# scaler lines) must exit 0 — so the elastic layer can't rot.
+env JAX_PLATFORMS=cpu python - <<'PY' || exit 1
+import json, os, subprocess, sys, tempfile, time
+
+import tpu_tfrecord.io as tfio
+from tpu_tfrecord import elastic, service
+from tpu_tfrecord.columnar import batch_to_rows
+from tpu_tfrecord.io.dataset import TFRecordDataset
+from tpu_tfrecord.metrics import METRICS
+from tpu_tfrecord.schema import LongType, StructField, StructType
+
+schema = StructType([StructField("id", LongType(), nullable=False)])
+root = tempfile.mkdtemp(prefix="tfr_elastic_smoke_")
+out = os.path.join(root, "ds")
+for s in range(6):
+    tfio.write([[i] for i in range(s * 30, (s + 1) * 30)], schema, out,
+               mode="append" if s else "overwrite")
+
+def epoch_rows(**kw):
+    ds = TFRecordDataset(out, batch_size=10, schema=schema,
+                         drop_remainder=False, **kw)
+    with ds.batches() as it:
+        return [r for b in it for r in batch_to_rows(b, ds.schema)]
+
+local = epoch_rows(num_epochs=1)
+
+plan_path = os.path.join(root, "plan.json")
+with open(plan_path, "w") as fh:
+    json.dump({"seed": 3, "rules": [{"op": "read", "kind": "stall",
+                                     "path": "part-", "times": None,
+                                     "stall_ms": 25}]}, fh)
+spool = os.path.join(root, "spool")
+d = service.ServiceDispatcher(lease_ttl_s=2.0).start()
+spawner = elastic.SubprocessSpawner(
+    d.addr, ("--fault-plan", plan_path, "--drain-grace", "0.2"),
+    env={**os.environ, "JAX_PLATFORMS": "cpu"})
+scaler = elastic.FleetScaler(
+    d, spawner, spool_dir=spool,
+    policy=elastic.ScalerPolicy(hysteresis=2, cooldown_s=0.4,
+                                min_workers=1, max_workers=3),
+    interval_s=0.2).start()
+try:
+    # the scaler itself brings up worker 1 (below-min refill)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and d.status()["alive"] < 1:
+        time.sleep(0.05)
+    assert d.status()["alive"] >= 1, d.status()
+
+    # OFFERED LOAD: 8 epochs through the service, every worker-side read
+    # under the seeded 25ms stall -> producer_bound -> the fleet GROWS
+    rows = epoch_rows(num_epochs=8, service=d.addr,
+                      service_deadline_ms=15000,
+                      telemetry_spool_dir=spool, spool_interval_s=0.1)
+    assert rows == local * 8, "elastic service rows differ from local"
+    ups = METRICS.counter("elastic.scale_ups")  # scaler is in-process
+    grows = [x for x in scaler.log if x["action"] == "scale_up"
+             and x["reason"] == "producer_bound"]
+    assert grows, f"scaler never grew the fleet: {scaler.log}"
+    peak = max(x["target"] for x in grows)
+    assert peak >= 2, scaler.log
+
+    # LOAD REMOVED: consumer closed (its spool says final) -> idle ->
+    # the scaler drains the fleet back to the 1-worker floor
+    active = []
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        active = [w for w in d.status()["workers"]
+                  if w["alive"] and not w["draining"]]
+        if len(active) == 1:
+            break
+        time.sleep(0.2)
+    assert len(active) == 1, d.status()
+    drains = [x for x in scaler.log if x["action"] == "scale_down"
+              and x["reason"] == "idle"]
+    assert drains, scaler.log
+
+    doc = subprocess.run([sys.executable, "tools/tfrecord_doctor.py",
+                          "serve-status", d.addr],
+                         capture_output=True, text=True)
+    assert doc.returncode == 0, (doc.returncode, doc.stdout, doc.stderr)
+    lines = [json.loads(l) for l in doc.stdout.splitlines() if l.strip()]
+    assert [l for l in lines if l.get("event") == "scaler"], lines
+    assert [l for l in lines if l.get("event") == "tenant"], lines
+finally:
+    scaler.stop()
+    spawner.reap()
+    d.stop()
+print("elastic smoke OK:", json.dumps({
+    "rows": len(rows),
+    "peak_workers": peak,
+    "scale_ups": ups,
+    "scale_downs": METRICS.counter("elastic.scale_downs"),
+}))
+PY
+
 echo "== remote smoke (real HTTP backend + seeded resets/stalls/truncation -> byte-identical epoch) =="
 # Serve a local dataset through the threaded Range server, fire a seeded
 # plan mixing connection resets, a server-side stall, a truncated body,

@@ -76,6 +76,7 @@ from tpu_tfrecord import telemetry
 __all__ = [
     "AutotuneController",
     "AutotunePolicy",
+    "BoundedClimber",
     "PipelineControl",
     "DEFAULT_INTERVAL_S",
     "default_max_workers",
@@ -96,6 +97,67 @@ def default_max_workers() -> int:
     except AttributeError:  # non-Linux
         ncpu = os.cpu_count() or 1
     return min(32, max(4, 2 * ncpu))
+
+
+class BoundedClimber:
+    """Verdict-streak hysteresis + wall-clock cooldown — the guard-rail
+    bookkeeping every bounded hill-climber here shares. One instance per
+    climber: the per-iterator pool controller (``AutotuneController``)
+    and the fleet-level scaler (``tpu_tfrecord.elastic.FleetScaler``)
+    both pace their moves through it, so "chaos-injected stalls can't
+    whipsaw the pool" is ONE invariant with one owner, not two
+    re-implementations that can drift.
+
+    ``observe(verdict)`` returns the verdict when it is actionable —
+    the same verdict for ``hysteresis`` consecutive observations AND the
+    cooldown window since the last move has passed — else None. The
+    caller reports a move with ``acted()`` (stamps the cooldown, resets
+    the streak). Verdicts outside ``actionable`` reset the streak: one
+    quiet tick between two producer_bound ticks means the boundness was
+    noise, not a regime.
+    """
+
+    def __init__(
+        self,
+        hysteresis: int,
+        cooldown_s: float,
+        clock: Callable[[], float] = time.monotonic,
+        actionable: tuple = ("producer_bound", "consumer_bound"),
+    ):
+        self.hysteresis = int(hysteresis)
+        self.cooldown_s = float(cooldown_s)
+        self.clock = clock
+        self.actionable = tuple(actionable)
+        self._verdict: Optional[str] = None
+        self._streak = 0
+        self._last_move = -float("inf")
+
+    @property
+    def streak(self) -> int:
+        return self._streak
+
+    def observe(self, verdict: Optional[str]) -> Optional[str]:
+        if verdict not in self.actionable:
+            self._verdict = None
+            self._streak = 0
+            return None
+        if verdict == self._verdict:
+            self._streak += 1
+        else:
+            self._verdict = verdict
+            self._streak = 1
+        if self._streak < self.hysteresis:
+            return None
+        if self.clock() - self._last_move < self.cooldown_s:
+            return None
+        return verdict
+
+    def acted(self) -> None:
+        self._last_move = self.clock()
+        self._streak = 0
+
+    def cooldown_remaining(self) -> float:
+        return max(0.0, self.cooldown_s - (self.clock() - self._last_move))
 
 
 class PipelineControl:
@@ -294,9 +356,13 @@ class AutotuneController:
         #: reason, tick) — the convergence trajectory bench/doctor report
         self.log: List[Dict[str, Any]] = []
         self._tick = 0
-        self._streak_verdict: Optional[str] = None
-        self._streak = 0
-        self._last_pool_move = -float("inf")
+        # guard-rail bookkeeping (hysteresis streaks + cooldown) is shared
+        # with the fleet scaler — one owner (BoundedClimber); the policy's
+        # knobs are re-read every tick so a policy mutated after
+        # construction still governs
+        self._climber = BoundedClimber(
+            self.policy.hysteresis, self.policy.cooldown_s, clock=clock
+        )
         # clamp the control's pool ceiling to the policy's — but never
         # below the configured starting pool (see PipelineControl)
         self.control.max_workers = max(
@@ -369,21 +435,10 @@ class AutotuneController:
 
     def _step_pool(self, payload: Dict[str, Any]) -> None:
         pol = self.policy
-        verdict = payload.get("verdict")
-        if verdict in ("producer_bound", "consumer_bound"):
-            if verdict == self._streak_verdict:
-                self._streak += 1
-            else:
-                self._streak_verdict = verdict
-                self._streak = 1
-        else:
-            self._streak_verdict = None
-            self._streak = 0
-            return
-        if self._streak < pol.hysteresis:
-            return
-        now = self.clock()
-        if now - self._last_pool_move < pol.cooldown_s:
+        self._climber.hysteresis = pol.hysteresis
+        self._climber.cooldown_s = pol.cooldown_s
+        verdict = self._climber.observe(payload.get("verdict"))
+        if verdict is None:
             return
         c = self.control
         workers = c.workers
@@ -406,8 +461,7 @@ class AutotuneController:
                     "prefetch", c.prefetch, want, reason, c.set_prefetch
                 )
         if moved:
-            self._last_pool_move = now
-            self._streak = 0
+            self._climber.acted()
 
     # -- readahead from observed IO bandwidth --------------------------------
 

@@ -866,6 +866,43 @@ class ShardCache:
         METRICS.count("cache.hits")
         return entry
 
+    def peek_entry(self, shard) -> bool:
+        """Advisory probe: will ``open_entry`` (as the serve path is
+        about to call it) be a hit? Used by the data service's
+        shared-cache accounting — a decode worker stamps ``cached: true``
+        on its eof so the dispatcher can count fleet-wide warm-cache
+        completions per tenant. Deliberately side-effect-free: no
+        ``cache.hits``/``cache.misses`` counters, no registry mutation,
+        no section-CRC verification pass (a held or registry-known entry
+        answers from memory; otherwise only the footer metadata is
+        read). A True here that open_entry then fails to serve (entry
+        corrupted in the microseconds between) merely overstates one
+        counter — it can never affect served rows."""
+        with self._lock:
+            if shard.path in self._entries:
+                return True
+        path = self.entry_path(shard.path)
+        try:
+            key = _registry_key(path)
+        except OSError:
+            return False  # no entry file at all
+        with _REGISTRY_LOCK:
+            entry = _ENTRY_REGISTRY.get(key)
+        try:
+            source = source_stat(shard.path, shard.size)
+            if entry is not None:
+                return (
+                    entry.footer.get("fingerprint") == self.fingerprint
+                    and _source_matches(entry.footer, source)
+                )
+            footer = load_footer(path)
+        except Exception:  # noqa: BLE001 — unreadable/corrupt = not cached
+            return False
+        return (
+            footer.get("fingerprint") == self.fingerprint
+            and _source_matches(footer, source)
+        )
+
     def populator(
         self, shard, source: Optional[Dict[str, Any]] = None
     ) -> Optional[CachePopulator]:

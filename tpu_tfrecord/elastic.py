@@ -1,0 +1,407 @@
+"""Elastic service layer: the decode fleet tracks offered load.
+
+The tf.data service paper (PAPERS.md, "A Case for Disaggregating ML Input
+Data Processing") argues disaggregation only pays for itself through
+*autoscaling* and *sharing*; PR 8's service held worker count fixed and
+served exactly one job. This module is the autoscaling half (the sharing
+half — tenant-keyed leasing and the fleet-wide warm cache — lives in
+service.py): a **FleetScaler** that closes the loop between the cluster
+flight recorder and the dispatcher's worker fleet.
+
+The control loop is the autotuner's (PR 6) lifted one level up:
+
+- **Sensor**: the PR 7 ``TelemetryAggregator`` merges every consumer
+  process's spool into one cluster verdict — ``producer_bound`` (the
+  trainers' prefetch queues are starved: decode capacity is the
+  bottleneck) or ``consumer_bound`` (queues full: decode capacity is
+  wasted) — over ALIVE processes only. No running consumer at all reads
+  as ``idle`` (offered load is zero).
+- **Actuator**: the dispatcher. Scale-up SPAWNS a decode-worker process
+  (``spawn`` callable — ``subprocess_spawner`` in production, an
+  in-process factory in tests/bench). Scale-down picks a victim
+  deterministically (last in sorted order among the active workers) and
+  marks it **draining** via ``ServiceDispatcher.drain``: its unstarted
+  leases are handed back for re-routing, new shards route around it, it
+  finishes whatever streams it is serving, says a clean goodbye (the
+  ``goodbye`` op; its telemetry spool lands a ``final: true`` snapshot),
+  and exits. A victim SIGKILLed mid-drain is indistinguishable from any
+  other dead worker: its heartbeat expires and consumers re-route with
+  exactly-once dedupe.
+- **Guard rails**: the same ``BoundedClimber`` hysteresis + cooldown the
+  per-iterator controller uses (tpu_tfrecord.autotune) — chaos-injected
+  stalls flip the verdict tick to tick, and a flapping verdict must
+  never whipsaw the fleet. Spawns in flight count against the ceiling
+  (``pending``) so a slow registration can't trigger a spawn storm.
+
+Determinism is the contract carried over from PR 8: every consumer's
+byte stream is identical across ANY resize, because shard ownership is
+consumer-tracked (acked offsets + redelivered-prefix dedupe) and the
+per-shard route merely picks WHO decodes — never what is decoded.
+
+Counters (in the scaler/dispatcher process): ``elastic.scale_ups``
+(spawn decisions), ``elastic.scale_downs`` (drain decisions),
+``elastic.drains`` (drains completed — goodbye received),
+``elastic.drained_leases`` (leases handed back at drain),
+``elastic.spawn_errors``. Gauge: ``elastic.workers``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from tpu_tfrecord import telemetry
+from tpu_tfrecord.autotune import BoundedClimber
+from tpu_tfrecord.metrics import METRICS, logger
+
+__all__ = [
+    "ScalerPolicy",
+    "FleetScaler",
+    "SubprocessSpawner",
+    "subprocess_spawner",
+]
+
+#: Scaler decision cadence when the caller sets none.
+DEFAULT_INTERVAL_S = 1.0
+
+
+@dataclass
+class ScalerPolicy:
+    """Bounds and pacing for the fleet-level hill-climber. The fleet only
+    moves after ``hysteresis`` consecutive same-verdict ticks and at most
+    once per ``cooldown_s`` wall-clock window (the whipsaw guard); worker
+    count is clamped to [min_workers, max_workers]; a spawn that has not
+    registered within ``pending_timeout_s`` stops counting against the
+    ceiling (the process died at exec — retrying is allowed again)."""
+
+    hysteresis: int = 2
+    cooldown_s: float = 5.0
+    min_workers: int = 1
+    max_workers: int = 8
+    pending_timeout_s: float = 30.0
+
+    def __post_init__(self) -> None:
+        if self.min_workers < 1:
+            raise ValueError("min_workers must be >= 1")
+        if self.max_workers < self.min_workers:
+            raise ValueError("max_workers must be >= min_workers")
+        if self.hysteresis < 1:
+            raise ValueError("hysteresis must be >= 1")
+
+
+class FleetScaler:
+    """Fleet-level bounded hill-climbing over the decode-worker count.
+
+    One scaler per dispatcher (the one-dispatcher-per-fleet caveat from
+    PR 8 extends naturally: the scaler lives in the dispatcher's process
+    and is the only thing that spawns or drains workers — two scalers
+    over one fleet would fight). ``step()`` is one decision tick; pass
+    ``interval_s`` and call ``start()`` for the production thread, or
+    drive ``step()`` directly with an injected clock in tests.
+
+    The verdict source is either a spool directory (a
+    ``fleet.TelemetryAggregator`` is built over it) or an injected
+    ``aggregator`` object with the same ``aggregate()`` shape — the test
+    seam. ``roles`` optionally scopes the verdict to specific telemetry
+    roles (e.g. only ``trainer`` processes) via the aggregator's role
+    filter.
+    """
+
+    def __init__(
+        self,
+        dispatcher,
+        spawn: Callable[[], Any],
+        spool_dir: Optional[str] = None,
+        aggregator=None,
+        policy: Optional[ScalerPolicy] = None,
+        interval_s: float = DEFAULT_INTERVAL_S,
+        roles: Optional[List[str]] = None,
+        trace_id: Optional[str] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if (spool_dir is None) == (aggregator is None):
+            raise ValueError(
+                "exactly one of spool_dir / aggregator must be given"
+            )
+        if aggregator is None:
+            from tpu_tfrecord import fleet
+
+            aggregator = fleet.TelemetryAggregator(
+                spool_dir, trace_id=trace_id
+            )
+        self.dispatcher = dispatcher
+        self.spawn = spawn
+        self.aggregator = aggregator
+        self.policy = policy or ScalerPolicy()
+        self.interval_s = float(interval_s)
+        self.roles = list(roles) if roles is not None else None
+        self.clock = clock
+        self._climber = BoundedClimber(
+            self.policy.hysteresis,
+            self.policy.cooldown_s,
+            clock=clock,
+            # "idle" (no running consumer) is a shrink signal the
+            # per-iterator controller never sees: zero offered load means
+            # the fleet should coast at min_workers
+            actionable=("producer_bound", "consumer_bound", "idle"),
+        )
+        #: full decision log, same shape discipline as AutotuneController
+        self.log: List[Dict[str, Any]] = []
+        self.last_decision: Optional[Dict[str, Any]] = None
+        self._tick = 0
+        self._pending: List[float] = []  # spawn times not yet registered
+        self._known_ids: set = set()
+        self._last_verdict: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # surface ourselves on the dispatcher's status() page
+        self.dispatcher.scaler_status = self.status(workers=0, draining=[])
+
+    # -- census ----------------------------------------------------------------
+
+    def _census(self) -> Dict[str, Any]:
+        """Who is in the fleet right now, from the dispatcher's books:
+        active (alive, not draining), draining (alive, marked), and the
+        pending spawns that have not registered yet."""
+        st = self.dispatcher.status()
+        ids = {w["worker_id"] for w in st["workers"]}
+        # registrations observed since the last tick retire pending spawns
+        for _ in ids - self._known_ids:
+            if self._pending:
+                self._pending.pop(0)
+        self._known_ids = ids
+        now = self.clock()
+        self._pending = [
+            t for t in self._pending
+            if now - t < self.policy.pending_timeout_s
+        ]
+        active = sorted(
+            w["worker_id"] for w in st["workers"]
+            if w["alive"] and not w.get("draining")
+        )
+        draining = sorted(
+            w["worker_id"] for w in st["workers"]
+            if w["alive"] and w.get("draining")
+        )
+        return {"active": active, "draining": draining, "status": st}
+
+    def _verdict(self) -> str:
+        """Cluster verdict over the alive, still-running consumers; no
+        such process at all = ``idle`` (load removed or never offered)."""
+        try:
+            snap = self.aggregator.aggregate(roles=self.roles)
+        except FileNotFoundError:
+            # spool dir not created yet (no consumer has ever spooled):
+            # indistinguishable from zero offered load
+            return "idle"
+        except OSError as e:
+            # any OTHER read failure (EACCES, EIO, an NFS hiccup) is an
+            # unreadable fleet, not an idle one — the aggregator's own
+            # invariant. Non-actionable: the tick is skipped, a loaded
+            # fleet is never drained on blindness.
+            METRICS.count("elastic.verdict_errors")
+            logger.warning("tfrecord.elastic verdict unreadable: %s", e)
+            return "unreadable"
+        running = [
+            p for p in snap.alive
+            if not p.final and telemetry.OCCUPANCY_GAUGE in p.gauges
+        ]
+        if not running:
+            return "idle"
+        return snap.verdict
+
+    # -- the decision tick -----------------------------------------------------
+
+    def step(self) -> Optional[Dict[str, Any]]:
+        """One control step: read the verdict, apply at most one fleet
+        move (spawn or drain), update the dispatcher's scaler status.
+        Returns the decision dict when a move was made, else None."""
+        self._tick += 1
+        pol = self.policy
+        census = self._census()
+        active, draining = census["active"], census["draining"]
+        effective = len(active) + len(self._pending)
+        verdict = self._verdict()
+        self._last_verdict = verdict
+        decision: Optional[Dict[str, Any]] = None
+        if effective < pol.min_workers:
+            # below the floor is not a hill-climbing question — refill
+            # immediately (dead workers, a fleet coming up from zero)
+            decision = self._spawn_one(effective, "below_min")
+        else:
+            act = self._climber.observe(verdict)
+            if act == "producer_bound" and effective < pol.max_workers:
+                decision = self._spawn_one(effective, act)
+                if decision is not None:
+                    self._climber.acted()
+            elif act in ("consumer_bound", "idle") and len(active) > pol.min_workers:
+                decision = self._drain_one(active, act)
+                if decision is not None:
+                    self._climber.acted()
+        METRICS.gauge("elastic.workers", float(len(active)))
+        self.dispatcher.scaler_status = self.status(
+            workers=len(active), draining=draining
+        )
+        return decision
+
+    def _spawn_one(self, effective: int, reason: str) -> Optional[Dict[str, Any]]:
+        try:
+            self.spawn()
+        except Exception as e:  # noqa: BLE001 — a failed exec must not
+            # kill the control loop; the next tick retries
+            METRICS.count("elastic.spawn_errors")
+            logger.warning("tfrecord.elastic spawn failed: %s", e)
+            return None
+        self._pending.append(self.clock())
+        METRICS.count("elastic.scale_ups")
+        return self._record("scale_up", reason, {"workers": effective,
+                                                 "target": effective + 1})
+
+    def _drain_one(self, active: List[str], reason: str) -> Optional[Dict[str, Any]]:
+        # deterministic victim: the LAST worker in sorted id order — the
+        # same pick on every replay of the same fleet state, and (because
+        # routing interleaves over the sorted alive list) the one whose
+        # removal perturbs the fewest existing assignments
+        victim = active[-1]
+        if not self.dispatcher.drain(victim):
+            return None
+        METRICS.count("elastic.scale_downs")
+        return self._record("scale_down", reason, {"workers": len(active),
+                                                   "target": len(active) - 1,
+                                                   "victim": victim})
+
+    def _record(self, action: str, reason: str, extra: Dict[str, Any]) -> Dict[str, Any]:
+        decision = {"tick": self._tick, "action": action, "reason": reason,
+                    **extra}
+        self.log.append(decision)
+        self.last_decision = decision
+        telemetry.instant("elastic.decision", action=action, reason=reason)
+        return decision
+
+    def status(self, workers: int, draining: List[str]) -> Dict[str, Any]:
+        """The ``scaler`` block surfaced on the dispatcher's status page
+        (and thus ``tfrecord_doctor serve-status``)."""
+        return {
+            "workers": workers,
+            "draining": list(draining),
+            "pending_spawns": len(self._pending),
+            "min_workers": self.policy.min_workers,
+            "max_workers": self.policy.max_workers,
+            "verdict": self._last_verdict,
+            "last_decision": self.last_decision,
+            "scale_ups": METRICS.counter("elastic.scale_ups"),
+            "scale_downs": METRICS.counter("elastic.scale_downs"),
+            "drains_completed": METRICS.counter("elastic.drains"),
+        }
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> "FleetScaler":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="tfr-fleet-scaler"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._thread = None
+
+    def __enter__(self) -> "FleetScaler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.step()
+            except Exception as e:  # noqa: BLE001 — the control loop is
+                # telemetry-adjacent: it must never die silently mid-fleet
+                METRICS.count("elastic.step_errors")
+                logger.warning("tfrecord.elastic step failed: %s", e)
+
+
+class SubprocessSpawner:
+    """The production ``spawn``: each call launches one
+    ``python -m tpu_tfrecord.service worker`` subprocess pointed at the
+    dispatcher, with any extra CLI args appended (``--cache``,
+    ``--spool-dir``, ``--fault-plan`` for chaos replays, ...). Tracks its
+    children so ``reap()`` can terminate whatever is still alive — a
+    drained worker exits on its own; reap is the shutdown safety net."""
+
+    def __init__(
+        self,
+        dispatcher_addr: str,
+        extra_args: tuple = (),
+        env: Optional[Dict[str, str]] = None,
+    ):
+        self.dispatcher_addr = str(dispatcher_addr)
+        self.extra_args = tuple(str(a) for a in extra_args)
+        self.env = dict(env) if env is not None else None
+        self.procs: List[Any] = []
+        self._lock = threading.Lock()
+
+    def __call__(self):
+        import subprocess
+        import sys
+
+        # keep the CALLER's cwd — relative dataset paths in job specs,
+        # relative --spool-dir/--fault-plan worker args, etc. must
+        # resolve exactly as they would for a manually started worker.
+        # Importability of `-m tpu_tfrecord.service` is guaranteed by
+        # prepending this package's parent to the child's PYTHONPATH
+        # instead.
+        env = dict(self.env) if self.env is not None else dict(os.environ)
+        pkg_parent = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        env["PYTHONPATH"] = (
+            pkg_parent + os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else pkg_parent
+        )
+        p = subprocess.Popen(
+            [sys.executable, "-m", "tpu_tfrecord.service", "worker",
+             "--dispatcher", self.dispatcher_addr, *self.extra_args],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            env=env,
+        )
+        with self._lock:
+            self.procs.append(p)
+        return p
+
+    def reap(self, timeout: float = 10.0) -> None:
+        with self._lock:
+            procs = list(self.procs)
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=timeout)
+                except Exception:  # noqa: BLE001
+                    try:
+                        p.kill()
+                    except OSError:
+                        pass
+
+
+def subprocess_spawner(
+    dispatcher_addr: str,
+    extra_args: tuple = (),
+    env: Optional[Dict[str, str]] = None,
+) -> SubprocessSpawner:
+    return SubprocessSpawner(dispatcher_addr, extra_args, env=env)

@@ -504,10 +504,19 @@ class TelemetryAggregator:
         #: list discloses a mixed directory when no filter is given).
         self.trace_id = trace_id
 
-    def processes(self) -> List[ProcessSnapshot]:
+    def processes(
+        self, roles: Optional[List[str]] = None
+    ) -> List[ProcessSnapshot]:
         """Newest snapshot per spool file, name-sorted (deterministic).
-        Raises OSError when the spool dir itself is unreadable — an
-        unreadable fleet must not look like an empty (healthy) one."""
+        ``roles`` filters to processes stamped with one of the given
+        telemetry roles — e.g. ``dispatcher --elastic --scaler-roles
+        trainer`` scopes the fleet scaler's verdict to trainer processes
+        only, so no other process's telemetry can ever vote on decode
+        capacity. (Unscoped, the verdict already ignores processes with
+        no occupancy gauge; the filter makes the boundary explicit
+        rather than incidental.) Raises OSError when the spool dir
+        itself is unreadable — an unreadable fleet must not look like an
+        empty (healthy) one."""
         names = sorted(
             n for n in os.listdir(self.spool_dir) if n.endswith(SPOOL_SUFFIX)
         )
@@ -516,7 +525,7 @@ class TelemetryAggregator:
             snap = read_spool(os.path.join(self.spool_dir, name))
             if snap is not None and (
                 self.trace_id is None or snap.trace_id == self.trace_id
-            ):
+            ) and (roles is None or snap.role in roles):
                 snaps.append(snap)
         return snaps
 
@@ -525,9 +534,9 @@ class TelemetryAggregator:
             return self.stale_after_s
         return 2.0 * snap.interval_s
 
-    def aggregate(self) -> FleetSnapshot:
+    def aggregate(self, roles: Optional[List[str]] = None) -> FleetSnapshot:
         now = self._clock()
-        procs = self.processes()
+        procs = self.processes(roles)
         alive: List[ProcessSnapshot] = []
         dead: List[ProcessSnapshot] = []
         counters: Dict[str, int] = {}

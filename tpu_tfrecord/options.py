@@ -186,6 +186,16 @@ class TFRecordOptions:
         rows, counted in ``service.fallbacks``. After a fallback, later
         shards probe the service with one quick attempt until it heals.
         None = never fall back (retry forever).
+      - elastic_min_workers / elastic_max_workers / elastic_interval_s:
+        the elastic decode fleet's floor, ceiling, and decision cadence
+        (tpu_tfrecord.elastic.FleetScaler). Like ``service_lease_ttl_s``
+        these are consumed by the dispatcher side (``python -m
+        tpu_tfrecord.service dispatcher --elastic`` defaults its flags
+        from them) — carried here so the whole elastic-fleet vocabulary
+        is configured and validated in one place. ``elastic_max_workers``
+        None defers to the scaler's policy default;
+        ``elastic_interval_s`` None defers to the scaler's default
+        cadence (1s).
     """
 
     record_type: RecordType = RecordType.EXAMPLE
@@ -220,6 +230,9 @@ class TFRecordOptions:
     service_lease_ttl_s: float = 10.0
     service_deadline_ms: float = 5000.0
     service_fallback_ms: Optional[float] = 30000.0
+    elastic_min_workers: int = 1
+    elastic_max_workers: Optional[int] = None
+    elastic_interval_s: Optional[float] = None
 
     _KNOWN_KEYS = (
         "recordType",
@@ -280,6 +293,12 @@ class TFRecordOptions:
         "serviceDeadlineMs",
         "service_fallback_ms",
         "serviceFallbackMs",
+        "elastic_min_workers",
+        "elasticMinWorkers",
+        "elastic_max_workers",
+        "elasticMaxWorkers",
+        "elastic_interval_s",
+        "elasticIntervalS",
     )
 
     ON_CORRUPT_POLICIES = ("raise", "skip_record", "skip_shard")
@@ -473,6 +492,28 @@ class TFRecordOptions:
             service_fallback_ms = float(service_fallback_ms)
             if service_fallback_ms < 0:
                 raise ValueError("service_fallback_ms must be >= 0 (or None)")
+        elastic_min_workers = int(
+            merged.pop("elastic_min_workers", merged.pop("elasticMinWorkers", 1))
+        )
+        if elastic_min_workers < 1:
+            raise ValueError("elastic_min_workers must be >= 1")
+        elastic_max_workers = merged.pop(
+            "elastic_max_workers", merged.pop("elasticMaxWorkers", None)
+        )
+        if elastic_max_workers is not None:
+            elastic_max_workers = int(elastic_max_workers)
+            if elastic_max_workers < elastic_min_workers:
+                raise ValueError(
+                    "elastic_max_workers must be >= elastic_min_workers "
+                    "(or None)"
+                )
+        elastic_interval_s = merged.pop(
+            "elastic_interval_s", merged.pop("elasticIntervalS", None)
+        )
+        if elastic_interval_s is not None:
+            elastic_interval_s = float(elastic_interval_s)
+            if elastic_interval_s <= 0:
+                raise ValueError("elastic_interval_s must be > 0 (or None)")
         if merged:
             import difflib
 
@@ -521,6 +562,9 @@ class TFRecordOptions:
             service_lease_ttl_s=service_lease_ttl_s,
             service_deadline_ms=service_deadline_ms,
             service_fallback_ms=service_fallback_ms,
+            elastic_min_workers=elastic_min_workers,
+            elastic_max_workers=elastic_max_workers,
+            elastic_interval_s=elastic_interval_s,
         )
 
     def with_schema(self, schema: StructType) -> "TFRecordOptions":

@@ -42,6 +42,35 @@ frames; chunk sections CRC-stamped with the cache container's own
 primitives) and is fault-injectable through the seeded ``FaultPlan``
 socket seam (``connect``/``recv`` rules), same replayable ledger as file
 faults.
+
+ISSUE 12 makes the service elastic and multi-tenant:
+
+- **Multi-tenant leasing**: the dispatcher keys its lease table by the
+  consumer's ``tenant`` — a digest of the dataset's decode fingerprint
+  (``TFRecordDataset._cache_ident``, the exact identity the columnar
+  epoch cache keys entries by) plus the global shard list. M consumers
+  from DIFFERENT jobs (different batch sizes, prefetch depths, resume
+  points) over the same dataset share ONE lease table, one done-set,
+  and — because the workers' epoch cache uses the same fingerprint —
+  one warm columnar cache: job 2 over an already-served dataset is
+  served entirely from cache (zero ground-truth reads, pinned by the
+  worker's ``cache.hits``/``cache.misses`` counters for local sources
+  and the Range server's file-GET counter for remote ones). Jobs with
+  different fingerprints get isolated lease tables and per-tenant
+  counters. Counters: ``service.tenants`` (distinct fingerprints seen),
+  ``service.shared_cache_hits`` (shard completions served from the warm
+  cache, reported by workers on ``eof`` and forwarded on
+  ``shard_done``).
+- **Draining** (the scale-down half of tpu_tfrecord.elastic): a worker
+  marked draining (``ServiceDispatcher.drain``) has its unstarted
+  leases handed back for re-routing (``elastic.drained_leases`` —
+  planned drift, never counted as a lease_reassignment), is excluded
+  from new routes, finishes the streams it is serving, then says a
+  clean ``goodbye`` (``elastic.drains``) and exits — its telemetry
+  spool lands a ``final: true`` snapshot, so the fleet doctor reads a
+  drained worker as finished, not dead. A victim SIGKILLed mid-drain
+  degrades to the ordinary dead-worker path: heartbeat expiry +
+  consumer re-route + exactly-once dedupe.
 """
 
 from __future__ import annotations
@@ -151,6 +180,7 @@ def build_job_spec(ds) -> Dict[str, Any]:
         "hash_buckets": ds.hash_buckets,
         "pack": ds.pack,
         "shards_digest": shards_digest(ds._reader.shards),
+        "tenant": tenant_digest(ds),
     }
     if ds.hash_buckets or ds.pack:
         # fused decode changes which COLUMNS a chunk carries (members fold
@@ -162,6 +192,21 @@ def build_job_spec(ds) -> Dict[str, Any]:
 def job_digest(spec: Dict[str, Any]) -> str:
     return hashlib.sha256(
         json.dumps(spec, sort_keys=True).encode()
+    ).hexdigest()[:16]
+
+
+def tenant_digest(ds) -> str:
+    """The multi-tenant sharing key: everything that changes decoded
+    chunk CONTENT (the dataset's cache fingerprint — the same identity
+    the columnar epoch cache keys entries by) plus the global shard
+    list. Two jobs that differ only in consumption shape (batch size,
+    prefetch, workers, resume point) produce the SAME tenant and share
+    one lease table and one warm cache fleet-wide; anything that changes
+    the rows themselves isolates them."""
+    ident = dict(ds._cache_ident())
+    ident["shards"] = shards_digest(ds._reader.shards)
+    return hashlib.sha256(
+        json.dumps(ident, sort_keys=True).encode()
     ).hexdigest()[:16]
 
 
@@ -210,9 +255,18 @@ class ServiceDispatcher:
         self._clock = clock
         self._lock = threading.Lock()
         self._workers: Dict[str, _WorkerInfo] = {}
-        self._leases: Dict[str, str] = {}  # "job/shard_path" -> worker_id
+        self._leases: Dict[str, str] = {}  # "tenant/shard_path" -> worker_id
         self._done: Dict[str, str] = {}
         self._reassignments = 0
+        # workers marked for graceful scale-down (wid -> drain-marked-at):
+        # excluded from new routes, expected to goodbye once idle
+        self._draining: Dict[str, float] = {}
+        # tenant (decode fingerprint) -> sharing bookkeeping: which
+        # consumers/jobs ride this lease table, and how many shard
+        # completions the warm cache absorbed
+        self._tenants: Dict[str, Dict[str, Any]] = {}
+        #: written by an attached elastic.FleetScaler; surfaced in status()
+        self.scaler_status: Optional[Dict[str, Any]] = None
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._conns = _ConnTracker()
@@ -247,6 +301,16 @@ class ServiceDispatcher:
         self._leases = {str(k): str(v) for k, v in dict(obj.get("leases", {})).items()}
         self._done = {str(k): str(v) for k, v in dict(obj.get("done", {})).items()}
         self._reassignments = int(obj.get("reassignments", 0))
+        self._draining = {
+            str(w): now for w in obj.get("draining", []) if str(w) in self._workers
+        }
+        for t, info in dict(obj.get("tenants", {})).items():
+            self._tenants[str(t)] = {
+                "consumers": set(info.get("consumers", [])),
+                "jobs": set(info.get("jobs", [])),
+                "shared_cache_hits": int(info.get("shared_cache_hits", 0)),
+                "completions": int(info.get("completions", 0)),
+            }
         trace = obj.get("trace")
         if isinstance(trace, dict):
             self._ctx = telemetry.adopt(
@@ -266,6 +330,16 @@ class ServiceDispatcher:
             "leases": self._leases,
             "done": self._done,
             "reassignments": self._reassignments,
+            "draining": sorted(self._draining),
+            "tenants": {
+                t: {
+                    "consumers": sorted(info["consumers"]),
+                    "jobs": sorted(info["jobs"]),
+                    "shared_cache_hits": info["shared_cache_hits"],
+                    "completions": info["completions"],
+                }
+                for t, info in self._tenants.items()
+            },
             "trace": self._ctx.to_json(),
         }
         try:
@@ -355,6 +429,8 @@ class ServiceDispatcher:
                 return self._op_route(msg)
             if op == "shard_done":
                 return self._op_shard_done(msg)
+            if op == "goodbye":
+                return self._op_goodbye(msg)
             if op == "status":
                 return self.status()
             if op == "ping":
@@ -376,6 +452,10 @@ class ServiceDispatcher:
             self._workers[wid] = _WorkerInfo(
                 wid, str(msg["addr"]), int(msg.get("pid", 0)), self._clock()
             )
+            # a re-registering worker is a FRESH worker (restart, or a
+            # journal-replayed identity coming back): any old drain mark
+            # belonged to its previous life
+            self._draining.pop(wid, None)
             self._journal_locked()
         return {
             "ok": True,
@@ -390,24 +470,96 @@ class ServiceDispatcher:
             info = self._workers.get(wid)
             if info is not None:
                 info.beat = self._clock()
+            drain = wid in self._draining
         # known=False sends the worker back through register (the
-        # journal-less restart path)
-        return {"ok": True, "known": info is not None}
+        # journal-less restart path); drain=True tells the worker to
+        # finish its in-flight streams, say goodbye, and exit
+        return {"ok": True, "known": info is not None, "drain": drain}
+
+    def drain(self, worker_id: str) -> bool:
+        """Mark one worker draining (the elastic scale-down path): its
+        current leases are handed back for re-routing (planned drift —
+        never counted as a lease_reassignment), new routes exclude it,
+        and its heartbeat replies carry ``drain: true`` until it says
+        goodbye. Returns False for an unknown or already-draining
+        worker."""
+        wid = str(worker_id)
+        with self._lock:
+            if wid not in self._workers or wid in self._draining:
+                return False
+            self._draining[wid] = self._clock()
+            released = [k for k, v in self._leases.items() if v == wid]
+            for k in released:
+                del self._leases[k]
+            self._journal_locked()
+        if released:
+            METRICS.count("elastic.drained_leases", len(released))
+        telemetry.instant(
+            "elastic.drain", worker=wid, released_leases=len(released)
+        )
+        return True
+
+    def _op_goodbye(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """A draining worker finished its streams and is exiting cleanly:
+        drop it from the books entirely (it is neither alive nor dead —
+        it is GONE, the same way a finished process never joins the fleet
+        doctor's dead list)."""
+        wid = str(msg["worker_id"])
+        with self._lock:
+            known = self._workers.pop(wid, None) is not None
+            was_draining = self._draining.pop(wid, None) is not None
+            for k in [k for k, v in self._leases.items() if v == wid]:
+                del self._leases[k]
+            self._journal_locked()
+        if known and was_draining:
+            METRICS.count("elastic.drains")
+            telemetry.instant("elastic.drain_complete", worker=wid)
+        return {"ok": True, "known": known}
+
+    def _tenant_locked(self, msg: Dict[str, Any]) -> str:
+        """Resolve the lease-table key space for one request: the
+        consumer's tenant (decode fingerprint — jobs that share it share
+        leases and the warm cache) with the job digest as the fallback
+        for tenant-less peers. Tracks which consumers/jobs ride each
+        tenant for the serve-status picture."""
+        tenant = str(msg.get("tenant") or msg["job"])
+        info = self._tenants.get(tenant)
+        if info is None:
+            info = self._tenants[tenant] = {
+                "consumers": set(), "jobs": set(),
+                "shared_cache_hits": 0, "completions": 0,
+            }
+            METRICS.count("service.tenants")
+        consumer = msg.get("consumer")
+        if consumer and len(info["consumers"]) < 1024:
+            # bounded: every short-lived iterator mints a fresh consumer
+            # id, and this census set rides the journal — a long-lived
+            # dispatcher must not grow it without limit (the count
+            # saturates at the cap; leases/done are the real state)
+            info["consumers"].add(str(consumer))
+        if msg.get("job") and len(info["jobs"]) < 1024:
+            info["jobs"].add(str(msg["job"]))
+        return tenant
 
     def _op_route(self, msg: Dict[str, Any]) -> Dict[str, Any]:
-        job = str(msg["job"])
         shard_path = str(msg["path"])
         shard_index = int(msg["shard_index"])
         exclude = {str(w) for w in msg.get("exclude", [])}
-        key = f"{job}/{shard_path}"
         with self._lock:
+            tenant = self._tenant_locked(msg)
+            key = f"{tenant}/{shard_path}"
             now = self._clock()
             alive = self._alive_locked(now)
-            candidates = [w for w in alive if w not in exclude]
+            # draining workers take no NEW shards — they are finishing
+            # what they already serve; consumer-witnessed suspects next
+            serving = [w for w in alive if w not in self._draining]
+            candidates = [w for w in serving if w not in exclude]
+            if not candidates:
+                candidates = [w for w in alive if w not in exclude]
             if not candidates:
                 # every alive worker is excluded: better a possibly-flaky
-                # worker than no route at all (the consumer's fallback
-                # budget still bounds the pain)
+                # (or draining) worker than no route at all (the
+                # consumer's fallback budget still bounds the pain)
                 candidates = alive
             if not candidates:
                 return {"error": "no_workers"}
@@ -435,12 +587,21 @@ class ServiceDispatcher:
             }
 
     def _op_shard_done(self, msg: Dict[str, Any]) -> Dict[str, Any]:
-        key = f"{msg['job']}/{msg['path']}"
         with self._lock:
+            tenant = self._tenant_locked(msg)
+            key = f"{tenant}/{msg['path']}"
             wid = self._leases.pop(key, None) or str(msg.get("worker_id", ""))
             if key not in self._done:
                 self._done[key] = wid
                 METRICS.count("service.shards_done")
+            info = self._tenants[tenant]
+            info["completions"] += 1
+            if msg.get("cached"):
+                # the worker served this shard entirely from the warm
+                # columnar cache (reported on its eof): the fleet-wide
+                # pay-decode-once payoff, made countable
+                info["shared_cache_hits"] += 1
+                METRICS.count("service.shared_cache_hits")
             self._journal_locked()
         return {"ok": True}
 
@@ -462,24 +623,45 @@ class ServiceDispatcher:
                     "addr": w.addr,
                     "pid": w.pid,
                     "alive": w.worker_id in alive,
+                    "draining": w.worker_id in self._draining,
                     "heartbeat_age_s": round(now - w.beat, 3),
                     "leases": sorted(leases_by.get(w.worker_id, [])),
                     "shards_done": done_by.get(w.worker_id, 0),
                 }
                 for w in sorted(self._workers.values(), key=lambda w: w.worker_id)
             ]
-            return {
+            tenants = {
+                t: {
+                    "consumers": len(info["consumers"]),
+                    "jobs": len(info["jobs"]),
+                    "leases": sum(
+                        1 for k in self._leases if k.startswith(t + "/")
+                    ),
+                    "shards_done": sum(
+                        1 for k in self._done if k.startswith(t + "/")
+                    ),
+                    "completions": info["completions"],
+                    "shared_cache_hits": info["shared_cache_hits"],
+                }
+                for t, info in sorted(self._tenants.items())
+            }
+            out = {
                 "ok": True,
                 "role": "dispatcher",
                 "addr": self.addr,
                 "lease_ttl_s": self.lease_ttl_s,
                 "workers": workers,
                 "alive": len(alive),
+                "draining": sorted(self._draining),
+                "tenants": tenants,
                 "shards_done": len(self._done),
                 "active_leases": len(self._leases),
                 "lease_reassignments": self._reassignments,
                 "trace_id": self._ctx.trace_id,
             }
+            if self.scaler_status is not None:
+                out["scaler"] = self.scaler_status
+            return out
 
 
 # ---------------------------------------------------------------------------
@@ -508,13 +690,24 @@ class DecodeWorker:
         host: str = "127.0.0.1",
         worker_id: Optional[str] = None,
         role: str = "decode_worker",
+        drain_grace_s: float = 1.0,
         clock=time.monotonic,
         sleep=None,
     ):
         self.dispatcher_addr = str(dispatcher_addr)
         self._options = options
         self._role = role
+        # drain completes only after the worker has been idle (no fetch
+        # stream in flight) for this long continuously — a consumer that
+        # just routed here must get its stream before the goodbye
+        self.drain_grace_s = float(drain_grace_s)
         self._clock = clock
+        self._inflight = 0
+        self._idle_since = clock()
+        self._inflight_lock = threading.Lock()
+        self._draining = threading.Event()
+        #: set once the goodbye has been sent and the worker stopped
+        self.drained = threading.Event()
         self._stop = threading.Event()
         self._sleep = sleep if sleep is not None else self._stop.wait
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -614,7 +807,27 @@ class DecodeWorker:
                     if not reply.get("known", False):
                         registered = False
                         continue
+                    if reply.get("drain"):
+                        self._draining.set()
                 backoff = 0.05
+                if self._draining.is_set():
+                    # draining: finish in-flight streams, then goodbye.
+                    # Poll fast — the beat cadence (TTL/3) would add
+                    # seconds of dead air to every scale-down.
+                    if self._drain_ready():
+                        try:
+                            sp.request(
+                                conn, self.dispatcher_addr,
+                                {"op": "goodbye", "proto": PROTO_VERSION,
+                                 "worker_id": self.worker_id},
+                            )
+                        finally:
+                            METRICS.count("service.worker_drained")
+                            self.drained.set()
+                            self.stop()
+                        return
+                    self._sleep(min(0.1, self.drain_grace_s / 2 or 0.1))
+                    continue
                 self._sleep(max(0.05, self.lease_ttl_s / HEARTBEAT_FRACTION))
             except (OSError, sp.ProtocolError, ServiceUnavailable):
                 if conn is not None:
@@ -626,6 +839,29 @@ class DecodeWorker:
                 registered = False
                 self._sleep(backoff)
                 backoff = min(backoff * 2, 2.0)
+
+    # -- drain bookkeeping ---------------------------------------------------
+
+    def _fetch_begin(self) -> None:
+        with self._inflight_lock:
+            self._inflight += 1
+
+    def _fetch_end(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._idle_since = self._clock()
+
+    def _drain_ready(self) -> bool:
+        """Drain completes once no fetch stream has been in flight for
+        ``drain_grace_s`` continuously: in-flight consumers finish their
+        shard, and a consumer holding a just-issued (stale) route gets
+        its stream rather than a closed port. A new fetch during the
+        grace resets it."""
+        with self._inflight_lock:
+            if self._inflight > 0:
+                return False
+            return self._clock() - self._idle_since >= self.drain_grace_s
 
     # -- data side ----------------------------------------------------------
 
@@ -662,8 +898,16 @@ class DecodeWorker:
                                        f"{PROTO_VERSION}, peer sent "
                                        f"{msg.get('proto')!r}"})
                 elif msg.get("op") == "fetch":
-                    if not self._handle_fetch(conn, msg, peer):
-                        return
+                    # draining workers still serve: routes already steer
+                    # new shards away, and rejecting a raced route would
+                    # only force a retry loop — "finish the current
+                    # lease" means every stream that reaches us completes
+                    self._fetch_begin()
+                    try:
+                        if not self._handle_fetch(conn, msg, peer):
+                            return
+                    finally:
+                        self._fetch_end()
                 elif msg.get("op") == "ping":
                     sp.send_msg(conn, {"ok": True, "worker_id": self.worker_id})
                 else:
@@ -822,6 +1066,15 @@ class DecodeWorker:
                                "error": f"unknown shard {shard_path!r}"})
             return True
         METRICS.count("service.fetches")
+        # Will this shard be served from the warm columnar cache (zero
+        # ground-truth reads)? Peeked BEFORE the stream so the eof can
+        # carry it to the consumer, which forwards it on shard_done —
+        # the dispatcher's per-tenant shared_cache_hits accounting.
+        cached = False
+        if getattr(ds, "_cache", None) is not None:
+            cached = ds._cache.peek_entry(ds.shards[idx])
+            if cached:
+                METRICS.count("service.cache_served")
         k = 0
         try:
             with telemetry.span("service.serve", shard=shard_path) as span:
@@ -831,7 +1084,7 @@ class DecodeWorker:
                     METRICS.count("service.chunks_sent")
                     METRICS.count("service.bytes_sent", nbytes)
                 span.set(chunks=k)
-            sp.send_msg(conn, {"op": "eof", "chunks": k})
+            sp.send_msg(conn, {"op": "eof", "chunks": k, "cached": cached})
             METRICS.count("service.shards_served")
             return True
         except wire.TFRecordCorruptionError as e:
@@ -876,6 +1129,15 @@ class ServiceClient:
         self._sleep = ds.retry_policy.sleep
         self._spec = build_job_spec(ds)
         self._job = job_digest(self._spec)
+        # the multi-tenant sharing key (decode fingerprint + shard list):
+        # jobs that share it share one lease table and one warm cache
+        self._tenant = self._spec["tenant"]
+        # consumer identity for the dispatcher's per-tenant census only —
+        # never part of any lease key
+        self._consumer_id = (
+            f"{socket.gethostname()}-{os.getpid()}-{os.urandom(3).hex()}"
+        )
+        self._fetch_cached = False
         self._dtype_of = ds.chunk_dtypes().__getitem__
         self._verify = opts.verify_crc
         self._global_index = {
@@ -923,11 +1185,15 @@ class ServiceClient:
             del self._suspects[wid]
         return list(self._suspects)
 
-    def _shard_done(self, worker_id: str, shard_path: str) -> None:
+    def _shard_done(
+        self, worker_id: str, shard_path: str, cached: bool = False
+    ) -> None:
         try:
             self._dispatcher_rpc(
                 {"op": "shard_done", "proto": PROTO_VERSION, "job": self._job,
-                 "path": shard_path, "worker_id": worker_id}
+                 "tenant": self._tenant, "consumer": self._consumer_id,
+                 "path": shard_path, "worker_id": worker_id,
+                 "cached": cached}
             )
         except (OSError, sp.ProtocolError):
             pass  # accounting only — the consumer's own position is truth
@@ -953,6 +1219,8 @@ class ServiceClient:
                         "op": "route",
                         "proto": PROTO_VERSION,
                         "job": self._job,
+                        "tenant": self._tenant,
+                        "consumer": self._consumer_id,
                         "path": shard.path,
                         "shard_index": self._global_index[shard.path],
                         "exclude": exclude,
@@ -974,7 +1242,7 @@ class ServiceClient:
                     attempt = 0
                 # a suspect that just completed a shard for us is healthy
                 self._suspects.pop(wid, None)
-                self._shard_done(wid, shard.path)
+                self._shard_done(wid, shard.path, cached=self._fetch_cached)
                 self._degraded = False
                 return
             except ServiceSpecError:
@@ -1009,6 +1277,7 @@ class ServiceClient:
                 self._sleep(delay)
 
     def _fetch_shard(self, worker_addr, shard_path, skip, epoch, pos, stop):
+        self._fetch_cached = False
         sock = sp.connect(worker_addr, timeout=self.deadline_s)
         try:
             sock.settimeout(self.deadline_s)
@@ -1045,6 +1314,9 @@ class ServiceClient:
                     continue  # keepalive: the worker is constructing its
                     # dataset — alive, just not streaming yet
                 elif op == "eof":
+                    # the worker's warm-cache disclosure rides the eof;
+                    # shard_chunks forwards it on shard_done
+                    self._fetch_cached = bool(msg.get("cached", False))
                     return
                 elif op == "error":
                     kind = msg.get("kind")
@@ -1129,6 +1401,7 @@ def _maybe_spool(args, role: str):
 def dispatcher_main(argv: List[str]) -> int:
     from tpu_tfrecord.options import TFRecordOptions
 
+    defaults = TFRecordOptions()
     ap = argparse.ArgumentParser(prog="tpu_tfrecord.service dispatcher")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--host", default="127.0.0.1")
@@ -1136,7 +1409,32 @@ def dispatcher_main(argv: List[str]) -> int:
                     help="assignment journal path (atomic rewrite; a "
                     "restarted dispatcher replays it)")
     ap.add_argument("--lease-ttl-s", type=float,
-                    default=TFRecordOptions().service_lease_ttl_s)
+                    default=defaults.service_lease_ttl_s)
+    ap.add_argument("--elastic", action="store_true",
+                    help="run a FleetScaler (tpu_tfrecord.elastic): spawn "
+                    "decode-worker subprocesses on producer_bound, drain "
+                    "them on consumer_bound/idle")
+    ap.add_argument("--scaler-spool", default=None, metavar="DIR",
+                    help="telemetry spool dir the scaler reads the cluster "
+                    "verdict from (default: --spool-dir)")
+    ap.add_argument("--min-workers", type=int,
+                    default=defaults.elastic_min_workers)
+    ap.add_argument("--max-workers", type=int, default=None,
+                    help="fleet ceiling (default: the options-vocabulary "
+                    "default, currently 8)")
+    ap.add_argument("--scale-interval", type=float,
+                    default=defaults.elastic_interval_s or 1.0)
+    ap.add_argument("--hysteresis", type=int, default=2)
+    ap.add_argument("--cooldown", type=float, default=5.0)
+    ap.add_argument("--scaler-roles", default=None, metavar="ROLE[,ROLE]",
+                    help="scope the scaler's cluster verdict to spools "
+                    "stamped with these telemetry roles (e.g. 'trainer'); "
+                    "default: every spooling process with an occupancy "
+                    "gauge votes")
+    ap.add_argument("--worker-arg", action="append", default=[],
+                    metavar="ARG", help="extra CLI arg for every spawned "
+                    "worker (repeatable; e.g. --worker-arg=--cache "
+                    "--worker-arg=auto)")
     _spool_args(ap)
     args = ap.parse_args(argv)
     telemetry.adopt_from_env(role="dispatcher")
@@ -1145,12 +1443,44 @@ def dispatcher_main(argv: List[str]) -> int:
         lease_ttl_s=args.lease_ttl_s,
     ).start()
     spool = _maybe_spool(args, "dispatcher")
+    scaler = None
+    spawner = None
+    if args.elastic:
+        from tpu_tfrecord import elastic
+
+        scaler_spool = args.scaler_spool or args.spool_dir
+        if scaler_spool is None:
+            ap.error("--elastic needs --scaler-spool (or --spool-dir): the "
+                     "scaler reads the cluster verdict from a spool dir")
+        spawner = elastic.subprocess_spawner(d.addr, tuple(args.worker_arg))
+        max_workers = (
+            args.max_workers
+            if args.max_workers is not None
+            else (defaults.elastic_max_workers or 8)
+        )
+        scaler = elastic.FleetScaler(
+            d, spawner, spool_dir=scaler_spool,
+            policy=elastic.ScalerPolicy(
+                hysteresis=args.hysteresis, cooldown_s=args.cooldown,
+                min_workers=args.min_workers, max_workers=max_workers,
+            ),
+            interval_s=args.scale_interval,
+            roles=(
+                [r.strip() for r in args.scaler_roles.split(",") if r.strip()]
+                if args.scaler_roles else None
+            ),
+        ).start()
     print(json.dumps({"event": "ready", "role": "dispatcher",
-                      "addr": d.addr, "pid": os.getpid()}), flush=True)
+                      "addr": d.addr, "pid": os.getpid(),
+                      "elastic": bool(scaler)}), flush=True)
     try:
         _run_forever(d._stop)
     finally:
+        if scaler is not None:
+            scaler.stop()
         d.stop()
+        if spawner is not None:
+            spawner.reap()
         if spool is not None:
             from tpu_tfrecord import fleet
 
@@ -1171,9 +1501,23 @@ def worker_main(argv: List[str]) -> int:
                     help="columnar epoch cache mode for this worker")
     ap.add_argument("--cache-dir", default=None)
     ap.add_argument("--cache-max-bytes", type=int, default=None)
+    ap.add_argument("--drain-grace", type=float, default=1.0,
+                    help="idle seconds before a draining worker says "
+                    "goodbye and exits (default 1.0)")
+    ap.add_argument("--fault-plan", default=None, metavar="PLAN_JSON",
+                    help="install a seeded FaultPlan (tpu_tfrecord.faults) "
+                    "for the life of this worker — deterministic chaos on "
+                    "a real fleet")
     _spool_args(ap)
     args = ap.parse_args(argv)
     telemetry.adopt_from_env(role=args.role)
+    if args.fault_plan is not None:
+        from tpu_tfrecord.faults import FaultPlan, install_chaos
+
+        with open(args.fault_plan) as fh:
+            plan = FaultPlan.from_json(json.load(fh))
+        # held for the process's whole life; process exit is the release
+        install_chaos(plan).__enter__()
     opts = TFRecordOptions.from_map(
         cache=args.cache, cache_dir=args.cache_dir,
         cache_max_bytes=args.cache_max_bytes,
@@ -1181,6 +1525,7 @@ def worker_main(argv: List[str]) -> int:
     w = DecodeWorker(
         args.dispatcher, options=opts, port=args.port, host=args.host,
         worker_id=args.worker_id, role=args.role,
+        drain_grace_s=args.drain_grace,
     ).start()
     spool = _maybe_spool(args, args.role)
     print(json.dumps({"event": "ready", "role": args.role, "addr": w.addr,
