@@ -138,16 +138,26 @@ def main() -> None:
         params, opt_state, loss = step_fn(params, opt_state, gb)
         return (params, opt_state), loss
 
+    # TFR_TRAIN_SPOOL_DIR spools this trainer (role=trainer) for the
+    # fleet doctor; the step-phase recorder runs regardless
+    spool = _harness.trainer_spool()
+    phases = _harness.StepPhases()
     t0 = time.perf_counter()
     it, _resume = _harness.resume_or_fresh(ds, ckpt_dir)
-    with it:
-        (params, opt_state), steps, duty = _harness.run_train_loop(
-            it, produce, step, (params, opt_state),
-            save=lambda s, live_it, _state: checkpoint.save_state(
-                ckpt_dir, live_it, step=s
-            ),
+    try:
+        with it:
+            (params, opt_state), steps, duty = _harness.run_train_loop(
+                it, produce, step, (params, opt_state),
+                save=lambda s, live_it, _state: checkpoint.save_state(
+                    ckpt_dir, live_it, step=s
+                ),
+                phases=phases,
+            )
+        _harness.finish(
+            ckpt_dir, steps, BATCH, t0, duty, stages=True, phases=phases
         )
-    _harness.finish(ckpt_dir, steps, BATCH, t0, duty, stages=True)
+    finally:
+        _harness.release_trainer_spool(spool)
 
 
 if __name__ == "__main__":

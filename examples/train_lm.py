@@ -15,6 +15,13 @@ The trainer that proves the model-parallel layer (ISSUE 10 / ROADMAP #4):
      atomic file every --save-every steps; kill -9 and rerun to resume —
      the packed-batch stream and the loss curve continue byte-identically
      (tools/verify.sh pins this)
+  6. fly the training flight recorder (ISSUE 13): every step decomposes
+     into train.data_wait/h2d/compute/ckpt phases with a windowed
+     input/compute/ckpt-bound verdict; --spool SPOOL_DIR joins the fleet
+     under the trainer role (read it with `tfrecord_doctor train`),
+     --trace-out saves a step-marked Chrome trace, and --diagnostics
+     folds the in-jit MoE/pipeline diagnostics (expert counts, dropped
+     fraction, gate entropy, measured bubble) into gauges each step
 
 Run on any JAX backend; for a local simulation:
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -182,13 +189,32 @@ def main() -> None:
                          "step (the kill/resume byte-identity evidence)")
     ap.add_argument("--data-dir", default="/tmp/tpu_tfrecord_lm/data")
     ap.add_argument("--ckpt-dir", default="/tmp/tpu_tfrecord_lm/ckpt")
+    ap.add_argument("--spool", default=None, metavar="SPOOL_DIR",
+                    help="spool this trainer's telemetry (role=trainer) "
+                         "into SPOOL_DIR for TelemetryAggregator / "
+                         "`tfrecord_doctor train`/`fleet`")
+    ap.add_argument("--spool-interval", type=float, default=None,
+                    metavar="SECONDS", help="spool snapshot cadence")
+    ap.add_argument("--trace-out", default=None, metavar="FILE",
+                    help="enable the flight recorder and save the Chrome "
+                         "trace (train.step spans + phase markers) here")
+    ap.add_argument("--diagnostics", action="store_true",
+                    help="in-jit model diagnostics: MoE expert counts/"
+                         "drops/entropy and the measured pipeline bubble, "
+                         "folded into gauges+histograms each step")
+    ap.add_argument("--moe", type=int, default=0, metavar="EXPERTS",
+                    help="swap every block's FFN for a top-2 MoE with "
+                         "this many experts (0 = dense; dp/dp_sp only)")
     args = ap.parse_args()
 
     generate(args.data_dir)
     mesh, axes, n_layers = pick_mesh(args.mesh)
+    if args.moe and "pipe_axis" in axes:
+        ap.error("--moe is not supported with --mesh dp_pp")
     cfg = lm.LMConfig(
         vocab_size=VOCAB, d_model=64, n_heads=4, n_layers=n_layers,
         max_len=SEQ_LEN, n_micro=8 if "pipe_axis" in axes else None,
+        moe_experts=args.moe,
     )
     print(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))} "
           f"mode={args.mesh}")
@@ -223,15 +249,23 @@ def main() -> None:
         packer.restore(payload["packer"])
 
     step_jit = jax.jit(
-        functools.partial(lm.train_step, cfg=cfg, tx=tx, mesh=mesh, **axes),
+        functools.partial(
+            lm.train_step, cfg=cfg, tx=tx, mesh=mesh,
+            diagnostics=args.diagnostics, **axes,
+        ),
         donate_argnums=(0, 1),
     )
     snaps: dict = {}
     digest_fh = open(args.digest_out, "a") if args.digest_out else None
+    last_diag: dict = {}
 
     def step_fn(state, gb):
         p, o = state
-        p, o, loss = step_jit(p, o, gb["tokens"])
+        if args.diagnostics:
+            p, o, loss, diag = step_jit(p, o, gb["tokens"])
+            last_diag["diag"] = diag
+        else:
+            p, o, loss = step_jit(p, o, gb["tokens"])
         return (p, o), loss
 
     def save(rel_step, _it, state):
@@ -256,33 +290,62 @@ def main() -> None:
             digest_fh.write(json.dumps(line) + "\n")
             digest_fh.flush()
 
+    def fold_step(rel_step, loss):
+        # the loss is already blocked on: fetching the tiny diag dict
+        # adds no sync point of its own
+        diag = last_diag.pop("diag", None)
+        if diag is not None:
+            _harness.fold_model_diagnostics(diag)
+        if digest_fh is not None:
+            on_step(rel_step, loss)
+
+    if args.trace_out:
+        from tpu_tfrecord import telemetry
+
+        telemetry.enable()
+    spool = _harness.trainer_spool(args.spool, args.spool_interval)
+    phases = _harness.StepPhases()
     t0 = time.perf_counter()
-    with ds.batches(resume) as it:
-        with DeviceIterator(
-            packed_stream(it, packer, snaps), mesh, axis=axes["data_axis"]
-        ) as dev_it:
-            (params, opt_state), steps, duty = _harness.run_train_loop(
-                dev_it,
-                produce=lambda gb: gb,  # DeviceIterator already placed it
-                step_fn=step_fn,
-                state=(params, opt_state),
-                save=save,
-                save_every=args.save_every,
-                on_step=on_step if digest_fh is not None else None,
-                max_steps=(
-                    args.steps - start_step if args.steps else None
-                ),
-            )
-    if digest_fh is not None:
-        digest_fh.close()
-    completed = args.steps and start_step + steps >= args.steps
-    if not completed and os.path.exists(ck.path):
-        # the epoch budget is exhausted: next run starts a fresh pass
-        os.remove(ck.path)
-    _harness.finish(
-        None, start_step + steps, BATCH, t0, duty, clear_state=False,
-        stages=True,
-    )
+    try:
+        with ds.batches(resume) as it:
+            with DeviceIterator(
+                packed_stream(it, packer, snaps), mesh, axis=axes["data_axis"]
+            ) as dev_it:
+                (params, opt_state), steps, duty = _harness.run_train_loop(
+                    dev_it,
+                    produce=lambda gb: gb,  # DeviceIterator already placed it
+                    step_fn=step_fn,
+                    state=(params, opt_state),
+                    save=save,
+                    save_every=args.save_every,
+                    on_step=(
+                        fold_step
+                        if (args.diagnostics or digest_fh is not None)
+                        else None
+                    ),
+                    max_steps=(
+                        args.steps - start_step if args.steps else None
+                    ),
+                    phases=phases,
+                )
+        if digest_fh is not None:
+            digest_fh.close()
+        completed = args.steps and start_step + steps >= args.steps
+        if not completed and os.path.exists(ck.path):
+            # the epoch budget is exhausted: next run starts a fresh pass
+            os.remove(ck.path)
+        if args.trace_out:
+            from tpu_tfrecord import telemetry
+
+            telemetry.RECORDER.save_chrome_trace(args.trace_out)
+            print(f"trace saved: {args.trace_out}")
+        _harness.finish(
+            None, start_step + steps, BATCH, t0, duty, clear_state=False,
+            stages=True, phases=phases,
+        )
+    finally:
+        # a clean exit lands the spool's `final: true` goodbye snapshot
+        _harness.release_trainer_spool(spool)
 
 
 if __name__ == "__main__":

@@ -16,12 +16,18 @@ from typing import Iterable, Sequence
 import jax
 
 
-def compiled_hlo(fn, *args, **kwargs) -> str:
-    """Compiled (post-SPMD-partitioning) HLO text of ``fn(*args)``.
-    ``fn`` may already be jitted; sharded example args pin their layouts."""
+def compiled(fn, *args, **kwargs):
+    """The compiled executable of ``fn(*args)`` — the ONE handle both the
+    HLO-text pins and the memory-shape pins read from. ``fn`` may already
+    be jitted; sharded example args pin their layouts."""
     if not hasattr(fn, "lower"):
         fn = jax.jit(fn)
-    return fn.lower(*args, **kwargs).compile().as_text()
+    return fn.lower(*args, **kwargs).compile()
+
+
+def compiled_hlo(fn, *args, **kwargs) -> str:
+    """Compiled (post-SPMD-partitioning) HLO text of ``fn(*args)``."""
+    return compiled(fn, *args, **kwargs).as_text()
 
 
 def assert_hlo(
@@ -48,8 +54,29 @@ def per_device_argument_bytes(fn, *args) -> int:
     """Per-device bytes of ``fn``'s compiled arguments — what ONE device
     holds of the inputs (shards, not global tensors). This is the number
     the scale-shape pins compare as meshes and microbatch counts grow."""
-    if not hasattr(fn, "lower"):
-        fn = jax.jit(fn)
-    ma = fn.lower(*args).compile().memory_analysis()
+    ma = compiled(fn, *args).memory_analysis()
     assert ma is not None, "backend reports no memory analysis"
     return int(ma.argument_size_in_bytes)
+
+
+def compiled_memory_bytes(fn, *args) -> dict:
+    """Per-device compiled-memory byte sizes from ``memory_analysis()``,
+    labeled with the backend that compiled them — so a CPU-mesh number
+    (the MULTICHIP partial) and the eventual real-device round land in
+    the SAME fields (ROADMAP #4). Returns {} when the backend reports no
+    memory analysis (some PJRT plugins)."""
+    ma = compiled(fn, *args).memory_analysis()
+    if ma is None:
+        return {}
+    out = {"backend": jax.default_backend()}
+    for field in (
+        "argument_size_in_bytes",
+        "output_size_in_bytes",
+        "temp_size_in_bytes",
+        "alias_size_in_bytes",
+        "generated_code_size_in_bytes",
+    ):
+        v = getattr(ma, field, None)
+        if v is not None:
+            out[field.replace("_size_in_bytes", "_bytes")] = int(v)
+    return out

@@ -581,18 +581,25 @@ class TestDoctorTune:
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.perf
 class TestThrottledDecodeChaos:
     """Every shard read pays an injected 30ms sleep (GIL released, like a
     real slow store), so throughput scales with decode-pool parallelism.
-    The hand-tuned reference runs 4 fixed workers; autotune starts from 1
-    worker / depth-1 prefetch and must climb back to >= 90% of the
-    reference — measured over the tail epochs, after the convergence the
-    trajectory demonstrates — with byte-identical rows."""
 
-    EPOCHS = 16
+    Two tiers (ISSUE 13 satellite — the wall-clock throughput ratio was a
+    pre-existing flake on the shared 2-vCPU box, where a loaded co-tenant
+    can slow EITHER leg arbitrarily and no fixed ratio holds):
 
-    def _run(self, out, **ds_kw):
+    - tier 1 (``test_autotune_grows_and_stays_deterministic``): every
+      assertion is counter-based and deterministic — the controller must
+      GROW the pool under throttle (its own decision log proves it) and
+      rows must be byte-identical to the hand-tuned run. No wall-clock
+      bar, so no interference flake.
+    - ``slow`` (``test_autotune_recovers_hand_tuned_throughput``): the
+      original >= 90%-of-hand-tuned throughput ratio, kept as the
+      convergence-quality bar for runs that opt into perf assertions.
+    """
+
+    def _run(self, out, epochs, **ds_kw):
         from tpu_tfrecord.faults import FaultPlan, FaultRule, install_chaos
 
         plan = FaultPlan(
@@ -602,7 +609,7 @@ class TestThrottledDecodeChaos:
         )
         ds = TFRecordDataset(
             out, batch_size=20, schema=SCHEMA, drop_remainder=False,
-            num_epochs=self.EPOCHS, use_mmap=False, **ds_kw,
+            num_epochs=epochs, use_mmap=False, **ds_kw,
         )
         rows = []
         epoch_times = []
@@ -621,19 +628,33 @@ class TestThrottledDecodeChaos:
         plan.release()
         return rows, epoch_times, tuner
 
-    def test_autotune_recovers_hand_tuned_throughput(self, tmp_path):
+    def test_autotune_grows_and_stays_deterministic(self, tmp_path):
+        """Tier-1 half: deterministic counter-based assertions only."""
         out = write_dataset(tmp_path, n_shards=6, rows_per_shard=40)
-        fixed_rows, fixed_times, _ = self._run(out, num_workers=4, prefetch=4)
-        tuned_rows, tuned_times, tuner = self._run(
-            out, num_workers=1, prefetch=1,
+        fixed_rows, _, _ = self._run(out, 4, num_workers=4, prefetch=4)
+        tuned_rows, _, tuner = self._run(
+            out, 4, num_workers=1, prefetch=1,
             autotune="on", autotune_interval_s=0.1,
         )
         # determinism across every pool/queue resize the controller made
         assert tuned_rows == fixed_rows
         # the controller actually adjusted knobs (bounded number of pulses)
         grows = [d for d in tuner.log if d["knob"] == "workers"]
-        assert grows and grows[0]["to"] > grows[0]["from"]
+        assert grows and grows[0]["to"] > grows[0]["from"], tuner.log
         assert tuner.control.workers > 1
+
+    @pytest.mark.slow
+    @pytest.mark.perf
+    def test_autotune_recovers_hand_tuned_throughput(self, tmp_path):
+        out = write_dataset(tmp_path, n_shards=6, rows_per_shard=40)
+        fixed_rows, fixed_times, _ = self._run(
+            out, 16, num_workers=4, prefetch=4
+        )
+        tuned_rows, tuned_times, tuner = self._run(
+            out, 16, num_workers=1, prefetch=1,
+            autotune="on", autotune_interval_s=0.1,
+        )
+        assert tuned_rows == fixed_rows
         # converged throughput: compare best epoch over the tail halves
         # (the head pays the deliberate mis-configuration + the climb).
         # Best-of, not mean-of: interference on this shared box is

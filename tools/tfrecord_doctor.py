@@ -18,7 +18,22 @@ Usage::
     tools/tfrecord_doctor.py report DATA_DIR              # bottleneck doctor
     tools/tfrecord_doctor.py tune DATA_DIR                # offline autotune
     tools/tfrecord_doctor.py fleet SPOOL_DIR              # cluster doctor
+    tools/tfrecord_doctor.py train SPOOL_DIR              # training doctor
     tools/tfrecord_doctor.py merge-trace OUT F1 F2 ...    # fuse Perfetto traces
+
+``fleet``, ``train``, and ``serve-status`` accept ``--json``: the same
+event objects, in the same order, as ONE machine-readable JSON document
+``{"events": [...]}`` instead of one object per line (exit codes
+unchanged — pinned by round-trip tests).
+
+The ``train`` subcommand is the TRAINING doctor: it reads the same spool
+directory as ``fleet`` but explains trainer processes — per-trainer step
+p50/p99 and steps/s, the step-phase decomposition
+(``train.data_wait``/``h2d``/``compute``/``ckpt`` shares), the
+input/compute/ckpt-bound training verdict, and the in-jit model
+diagnostics (MoE expert imbalance / dropped fraction / gate entropy,
+measured pipeline bubble) when the trainer folded them. Exit 0 = report;
+2 = no trainer spools.
 
 The ``report`` subcommand is the bottleneck doctor: it runs N batches of
 the real pipeline with the flight recorder on (tpu_tfrecord.telemetry)
@@ -491,6 +506,40 @@ def tune_main(argv: List[str]) -> int:
     return 0
 
 
+class _Emitter:
+    """The doctor's one stdout owner. Default: one JSON object per line
+    (the machine-first text format every subcommand always emitted).
+    With ``--json`` the SAME objects, in the SAME order, are buffered and
+    dumped as ONE machine-readable document ``{"events": [...]}`` at the
+    end — a round-trip mirror of the text lines (pinned by tests), with
+    exit codes unchanged. Call sites wrap their body in try/finally so
+    every return path lands the document."""
+
+    def __init__(self, as_doc: bool = False):
+        self.as_doc = as_doc
+        self.events: List[Dict] = []
+
+    def __call__(self, obj: Dict) -> None:
+        if self.as_doc:
+            self.events.append(obj)
+        else:
+            sys.stdout.write(json.dumps(obj, sort_keys=True) + "\n")
+
+    def close(self) -> None:
+        if self.as_doc:
+            sys.stdout.write(
+                json.dumps({"events": self.events}, sort_keys=True) + "\n"
+            )
+
+
+def _add_json_flag(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit one JSON document {\"events\": [...]} mirroring the "
+        "text lines (same objects, same order, same exit code)",
+    )
+
+
 def fleet_main(argv: List[str]) -> int:
     """The ``fleet`` subcommand: aggregate a telemetry spool dir and print
     the cluster picture. Exit 0 = report produced (dead workers are a
@@ -512,12 +561,18 @@ def fleet_main(argv: List[str]) -> int:
         "keeps previous runs' files; the fleet line's trace_ids list "
         "shows what is mixed in)",
     )
+    _add_json_flag(ap)
     args = ap.parse_args(argv)
 
-    from tpu_tfrecord import fleet, telemetry
+    emit = _Emitter(args.json)
+    try:
+        return _fleet_report(args, emit)
+    finally:
+        emit.close()
 
-    def emit(obj: Dict) -> None:
-        sys.stdout.write(json.dumps(obj, sort_keys=True) + "\n")
+
+def _fleet_report(args, emit) -> int:
+    from tpu_tfrecord import fleet, telemetry
 
     try:
         agg = fleet.TelemetryAggregator(
@@ -564,6 +619,10 @@ def fleet_main(argv: List[str]) -> int:
         # and dividing by those would understate a parallel worker by
         # its thread count
         wall = p.heartbeat - p.created if p.created else 0.0
+        # a process that recorded train phases is a TRAINER: its verdict
+        # is the step-phase one (input/compute/ckpt bound), not the
+        # prefetch-occupancy one readers get
+        shares = fleet.train_phase_shares(p)
         line: Dict = {
             "event": "proc",
             "host": p.host,
@@ -578,8 +637,12 @@ def fleet_main(argv: List[str]) -> int:
                 round(decode[0] / wall, 1)
                 if decode and wall > 0 else None
             ),
-            "verdict": telemetry.boundness_verdict(
-                p.gauges.get(telemetry.OCCUPANCY_GAUGE)
+            "verdict": (
+                telemetry.training_verdict(shares)
+                if shares is not None
+                else telemetry.boundness_verdict(
+                    p.gauges.get(telemetry.OCCUPANCY_GAUGE)
+                )
             ),
         }
         try:
@@ -644,12 +707,18 @@ def serve_status_main(argv: List[str]) -> int:
         "--timeout", type=float, default=5.0, metavar="SECONDS",
         help="connect/request deadline (default 5s)",
     )
+    _add_json_flag(ap)
     args = ap.parse_args(argv)
 
-    from tpu_tfrecord import service
+    emit = _Emitter(args.json)
+    try:
+        return _serve_status_report(args, emit)
+    finally:
+        emit.close()
 
-    def emit(obj: Dict) -> None:
-        sys.stdout.write(json.dumps(obj, sort_keys=True) + "\n")
+
+def _serve_status_report(args, emit) -> int:
+    from tpu_tfrecord import service
 
     try:
         status = service.fetch_status(args.dispatcher, timeout=args.timeout)
@@ -727,6 +796,167 @@ def serve_status_main(argv: List[str]) -> int:
     return 0
 
 
+def train_main(argv: List[str]) -> int:
+    """The ``train`` subcommand: the trainer-side cluster doctor. Reads
+    the same telemetry spool directory as ``fleet`` but explains the
+    TRAINING loop: one ``{"event": "trainer", ...}`` line per spooling
+    trainer process (step count + p50/p99 step latency, steps/s over the
+    wall window, phase shares, the input/compute/ckpt-bound verdict, the
+    MoE expert-imbalance line and the measured pipeline bubble when the
+    in-jit model diagnostics ran) and one final ``{"event": "train", ...}``
+    summary (merged step quantiles — exact histogram-bucket merges —
+    fleet-level phase shares weighted by phase seconds, the fleet
+    training verdict). Exit 0 = report produced; 2 = unreadable spool dir
+    or no trainer spools in it."""
+    ap = argparse.ArgumentParser(
+        prog="tfrecord_doctor train",
+        description="Training doctor: explain where trainer steps went",
+    )
+    ap.add_argument("spool_dir", help="telemetry spool directory")
+    ap.add_argument(
+        "--stale-after", type=float, default=None, metavar="SECONDS",
+        help="heartbeat age beyond which a trainer is dead "
+        "(default: 2x each process's own snapshot interval)",
+    )
+    ap.add_argument(
+        "--trace-id", default=None, metavar="ID",
+        help="only read spool files from this run",
+    )
+    ap.add_argument(
+        "--role", default="trainer", metavar="ROLE",
+        help="telemetry role that marks a trainer (default: trainer); "
+        "processes with train.* phases recorded qualify regardless",
+    )
+    _add_json_flag(ap)
+    args = ap.parse_args(argv)
+
+    emit = _Emitter(args.json)
+    try:
+        return _train_report(args, emit)
+    finally:
+        emit.close()
+
+
+def _train_report(args, emit) -> int:
+    from tpu_tfrecord import fleet, telemetry
+    from tpu_tfrecord.telemetry import Histogram
+
+    try:
+        agg = fleet.TelemetryAggregator(
+            args.spool_dir, stale_after_s=args.stale_after,
+            trace_id=args.trace_id,
+        )
+        # the aggregator owns liveness semantics (final-snapshot
+        # handling, the 2x-interval default, the injectable clock):
+        # reusing its classification keeps `doctor train` and
+        # `doctor fleet` agreeing about the same spool file
+        snap = agg.aggregate()
+    except Exception as e:
+        emit({"event": "error", "path": args.spool_dir, "error": str(e)})
+        return 2
+    procs = snap.processes
+    dead_ids = {id(p) for p in snap.dead}
+    # a trainer is anything stamped with the trainer role OR anything
+    # that recorded the train phases (a custom-role harness user still
+    # gets a report); shares are derived once per process here and
+    # reused by the report loop
+    trainers = [
+        (p, shares)
+        for p in procs
+        for shares in [fleet.train_phase_shares(p)]
+        if p.role == args.role or shares is not None
+    ]
+    if not trainers:
+        emit({
+            "event": "error", "path": args.spool_dir,
+            "error": (
+                f"no trainer spools found ({len(procs)} spool files, "
+                f"roles: {sorted({p.role for p in procs})})"
+                if procs else "no spool files found"
+            ),
+        })
+        return 2
+    now = agg._clock()
+    merged_step = Histogram()
+    fleet_phase_seconds: Dict[str, float] = {}
+    fleet_steps = 0
+    for p, shares in trainers:
+        steps = p.counters.get("train.steps", 0)
+        fleet_steps += steps
+        wall = p.heartbeat - p.created if p.created else 0.0
+        phase_seconds = {
+            phase: round(p.stages[telemetry.TRAIN_STAGE_PREFIX + phase][3], 6)
+            for phase in telemetry.TRAIN_PHASES
+            if telemetry.TRAIN_STAGE_PREFIX + phase in p.stages
+        }
+        for phase, s in phase_seconds.items():
+            fleet_phase_seconds[phase] = fleet_phase_seconds.get(phase, 0.0) + s
+        line: Dict = {
+            "event": "trainer",
+            "host": p.host,
+            "pid": p.pid,
+            "role": p.role,
+            "alive": id(p) not in dead_ids,
+            **({"finished": True} if p.final else {}),
+            "heartbeat_age_s": round(p.heartbeat_age(now), 3),
+            "steps": steps,
+            "steps_per_sec": (
+                round(steps / wall, 3) if steps and wall > 0 else None
+            ),
+            "phase_shares": (
+                {k: round(v, 4) for k, v in shares.items()}
+                if shares else None
+            ),
+            "phase_seconds": phase_seconds,
+            "verdict": telemetry.training_verdict(shares),
+        }
+        step_state = p.hists.get("train.step")
+        if step_state:
+            try:
+                h = Histogram.from_states([step_state])
+                merged_step.merge_state(step_state)
+                q = h.quantiles()
+                line["step_p50_ms"] = round(q["p50_s"] * 1e3, 3)
+                line["step_p99_ms"] = round(q["p99_s"] * 1e3, 3)
+            except (ValueError, TypeError, KeyError, IndexError):
+                pass  # one trainer's corrupt hist loses its quantiles only
+        # in-jit model diagnostics, when the trainer folded them
+        moe = {
+            k.split(".", 1)[1]: v
+            for k, v in p.gauges.items() if k.startswith("moe.")
+        }
+        if moe:
+            line["moe"] = {k: round(v, 4) for k, v in sorted(moe.items())}
+        bubble = p.gauges.get("pipeline.bubble_fraction")
+        if bubble is not None:
+            line["pipeline_bubble_fraction"] = round(bubble, 4)
+        if p.skipped_lines:
+            line["skipped_lines"] = p.skipped_lines
+        emit(line)
+    total_phase = sum(fleet_phase_seconds.values())
+    fleet_shares = (
+        {k: round(v / total_phase, 4) for k, v in fleet_phase_seconds.items()}
+        if total_phase > 0 else None
+    )
+    summary: Dict = {
+        "event": "train",
+        "path": args.spool_dir,
+        "trainers": len(trainers),
+        "steps": fleet_steps,
+        "phase_shares": fleet_shares,
+        "verdict": telemetry.training_verdict(fleet_shares),
+        "trace_ids": sorted(
+            {p.trace_id for p, _ in trainers if p.trace_id}
+        ),
+    }
+    if merged_step.count:
+        q = merged_step.quantiles()
+        summary["step_p50_ms"] = round(q["p50_s"] * 1e3, 3)
+        summary["step_p99_ms"] = round(q["p99_s"] * 1e3, 3)
+    emit(summary)
+    return 0
+
+
 def merge_trace_main(argv: List[str]) -> int:
     """The ``merge-trace`` subcommand: fuse per-process Chrome traces into
     one Perfetto timeline. Exit 0 = merged; 2 = unreadable/malformed input."""
@@ -775,6 +1005,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return tune_main(argv[1:])
     if argv and argv[0] == "fleet":
         return fleet_main(argv[1:])
+    if argv and argv[0] == "train":
+        return train_main(argv[1:])
     if argv and argv[0] == "serve-status":
         return serve_status_main(argv[1:])
     if argv and argv[0] == "merge-trace":

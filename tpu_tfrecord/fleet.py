@@ -74,6 +74,7 @@ __all__ = [
     "read_spool",
     "FleetSnapshot",
     "TelemetryAggregator",
+    "train_phase_shares",
 ]
 
 #: Spool files are ``<host>-<pid>.spool.jsonl`` inside the spool dir; the
@@ -679,6 +680,10 @@ class TelemetryAggregator:
                 (
                     (f'stage="{esc(name)}"', q)
                     for name, q in sorted(snap.quantiles().items())
+                    # dimensionless diagnostic hists (moe.*, pipeline.*)
+                    # are fractions, not seconds — a latency family must
+                    # not carry them
+                    if telemetry.is_latency_hist(name)
                 ),
             ),
         )
@@ -704,3 +709,30 @@ def quantiles_ms_from_states(hists: Dict[str, dict]) -> Dict[str, Dict[str, floa
             for name, state in hists.items()
         }
     )
+
+
+def train_phase_shares(snap: ProcessSnapshot) -> Optional[Dict[str, float]]:
+    """A trainer's step-phase shares from its spool snapshot, or None for
+    a process that never recorded the train phases (a reader/worker).
+
+    Prefers the WINDOWED ``train.share.<phase>`` gauges the harness
+    publishes (the recent regime — what the verdict should describe);
+    falls back to shares computed from the cumulative ``train.<phase>``
+    stage seconds (lifetime average) for trainers that died before a
+    window completed. Keys are telemetry.TRAIN_PHASES entries."""
+    gauges = {
+        phase: snap.gauges[telemetry.TRAIN_SHARE_PREFIX + phase]
+        for phase in telemetry.TRAIN_PHASES
+        if telemetry.TRAIN_SHARE_PREFIX + phase in snap.gauges
+    }
+    if gauges:
+        return gauges
+    seconds = {
+        phase: snap.stages[telemetry.TRAIN_STAGE_PREFIX + phase][3]
+        for phase in telemetry.TRAIN_PHASES
+        if telemetry.TRAIN_STAGE_PREFIX + phase in snap.stages
+    }
+    total = sum(seconds.values())
+    if total <= 0:
+        return None
+    return {phase: s / total for phase, s in seconds.items()}

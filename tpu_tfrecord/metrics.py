@@ -103,10 +103,18 @@ class Metrics:
         counters ``read.backpressure_waits``/``write.backpressure_waits``,
         the autotune decision counter ``autotune.adjustments`` (each
         controller knob move — the current knob VALUES live in the
-        ``autotune.<knob>`` gauges), and the cluster-spool counters
+        ``autotune.<knob>`` gauges), the cluster-spool counters
         (``fleet.spool_writes`` = snapshots landed in the telemetry spool,
         ``fleet.spool_errors`` = snapshot attempts that failed — spooling
-        is telemetry, it never raises into the pipeline).
+        is telemetry, it never raises into the pipeline), and the
+        training flight recorder's ``train.steps`` (one per completed
+        harness step — the step-phase decomposition itself rides the
+        ``train.data_wait``/``train.h2d``/``train.compute``/``train.ckpt``
+        /``train.step`` STAGES with latency histograms, the windowed
+        phase shares ride ``train.share.<phase>`` gauges, and the in-jit
+        model diagnostics ride the ``moe.dropped_fraction``/
+        ``moe.gate_entropy``/``moe.expert_imbalance``/
+        ``pipeline.bubble_fraction`` gauges + histograms).
 
         INSTANTANEOUS values (queue depths, occupancies, in-flight worker
         counts) belong in ``gauge()``, not here — a counter only goes up.

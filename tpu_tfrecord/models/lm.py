@@ -139,10 +139,12 @@ def _moe_cfg(cfg: LMConfig) -> "_moe.MoEConfig":
 
 def _block(
     layer, x, cfg: LMConfig, mesh=None, seq_axis=None, data_axis=None,
-    expert_axis=None,
+    expert_axis=None, diagnostics=False,
 ):
     """One pre-norm decoder block on x [B, L, D]. Attention flavor: zigzag
-    causal ring over ``seq_axis`` when given, else dense causal."""
+    causal ring over ``seq_axis`` when given, else dense causal. Returns
+    (x, aux, moe_diag) — moe_diag is None unless ``diagnostics`` is set
+    on an MoE block (models.moe _diag_dict vocabulary)."""
     dt = cfg.dtype
     b, l, _ = x.shape
     h, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
@@ -158,15 +160,24 @@ def _block(
     x = x + _dense(layer["proj"], att.reshape(b, l, cfg.d_model), dt)
     if cfg.moe_experts > 0:
         if mesh is not None and expert_axis is not None:
-            y, aux = _moe.moe_apply_ep(
+            out = _moe.moe_apply_ep(
                 layer["moe"], _rms_norm(x), _moe_cfg(cfg), mesh,
                 expert_axis=expert_axis, data_axis=data_axis,
+                diagnostics=diagnostics,
             )
         else:
-            y, aux = _moe.moe_apply(layer["moe"], _rms_norm(x), _moe_cfg(cfg))
-        return x + y, aux
+            out = _moe.moe_apply(
+                layer["moe"], _rms_norm(x), _moe_cfg(cfg),
+                diagnostics=diagnostics,
+            )
+        y, aux = out[0], out[1]
+        return x + y, aux, (out[2] if diagnostics else None)
     y = _dense(layer["mlp_in"], _rms_norm(x), dt)
-    return x + _dense(layer["mlp_out"], jax.nn.gelu(y), dt), jnp.float32(0.0)
+    return (
+        x + _dense(layer["mlp_out"], jax.nn.gelu(y), dt),
+        jnp.float32(0.0),
+        None,
+    )
 
 
 def forward(
@@ -178,12 +189,22 @@ def forward(
     seq_axis: Optional[str] = None,
     pipe_axis: Optional[str] = None,
     expert_axis: Optional[str] = None,
+    diagnostics: bool = False,
 ):
-    """tokens [B, L+1] int32 -> (logits [B, L, V] f32, aux f32). The
-    model reads tokens[:, :-1]; the caller scores against tokens[:, 1:]
-    (`loss_fn` does). Mesh axes select the parallelism (module docstring);
-    pipe and seq modes are mutually exclusive (a pipeline stage owns its
-    devices — the sequence stays whole within it)."""
+    """tokens [B, L+1] int32 -> (logits [B, L, V] f32, aux f32[, diag]).
+    The model reads tokens[:, :-1]; the caller scores against
+    tokens[:, 1:] (`loss_fn` does). Mesh axes select the parallelism
+    (module docstring); pipe and seq modes are mutually exclusive (a
+    pipeline stage owns its devices — the sequence stays whole within
+    it).
+
+    ``diagnostics`` (a static flag — False compiles the exact pre-flag
+    program) returns a third element: the in-jit model diagnostics dict
+    (ISSUE 13). MoE models carry ``expert_tokens``/``expert_kept`` [E]
+    (summed across layers), ``dropped_fraction``, ``gate_entropy``
+    (averaged across layers); the pipeline mode carries the measured
+    ``bubble_fraction``/``useful_ticks``/``total_ticks``. All
+    static-shaped and stop_gradient'd by the underlying layers."""
     if pipe_axis is not None and seq_axis is not None:
         raise ValueError(
             "pipe_axis and seq_axis are mutually exclusive: inside a "
@@ -206,6 +227,7 @@ def forward(
         + params["pos"][:l].astype(dt)[None]
     )                                                          # [B, L, D]
     aux_total = jnp.float32(0.0)
+    diag: Dict[str, jax.Array] = {}
     if pipe_axis is not None:
         n_stages = mesh.shape[pipe_axis]
         if cfg.n_layers % n_stages:
@@ -225,55 +247,96 @@ def forward(
         def stage_fn(p_stage, xs):
             for j in range(per_stage):
                 layer = jax.tree.map(lambda a: a[j], p_stage)
-                xs, _ = _block(layer, xs, cfg)
+                xs, _, _ = _block(layer, xs, cfg)
             return xs
 
         xs = x.reshape((m, b // m) + x.shape[1:])              # [M, mb, L, D]
         batch_spec = P(data_axis) if data_axis else P()
-        xs = _pipeline.pipeline_apply(
+        out = _pipeline.pipeline_apply(
             stage_fn, stage_params, xs, mesh, pipe_axis=pipe_axis,
-            batch_spec=batch_spec,
+            batch_spec=batch_spec, diagnostics=diagnostics,
         )
+        if diagnostics:
+            xs, diag = out
+        else:
+            xs = out
         x = xs.reshape((b,) + xs.shape[2:])
     else:
+        moe_diags = []
         for i in range(cfg.n_layers):
             layer = jax.tree.map(lambda a: a[i], params["blocks"])
-            x, aux = _block(
+            x, aux, mdiag = _block(
                 layer, x, cfg, mesh=mesh, seq_axis=seq_axis,
                 data_axis=data_axis, expert_axis=expert_axis,
+                diagnostics=diagnostics,
             )
             aux_total = aux_total + aux
+            if mdiag is not None:
+                moe_diags.append(mdiag)
+        if moe_diags:
+            n = len(moe_diags)
+            # counts SUM across layers (every layer routes the full
+            # stream: expert_tokens sums to n_layers * T * top_k);
+            # fractions/entropy AVERAGE — the per-layer regime
+            diag = {
+                "expert_tokens": sum(d["expert_tokens"] for d in moe_diags),
+                "expert_kept": sum(d["expert_kept"] for d in moe_diags),
+                "dropped_fraction":
+                    sum(d["dropped_fraction"] for d in moe_diags) / n,
+                "gate_entropy":
+                    sum(d["gate_entropy"] for d in moe_diags) / n,
+            }
     logits = _dense(params["head"], _rms_norm(x), dt).astype(jnp.float32)
+    if diagnostics:
+        return logits, aux_total, diag
     return logits, aux_total
 
 
 def loss_fn(params, tokens, cfg: LMConfig, mesh=None, data_axis=None,
-            seq_axis=None, pipe_axis=None, expert_axis=None) -> jax.Array:
+            seq_axis=None, pipe_axis=None, expert_axis=None,
+            diagnostics: bool = False):
     """Mean next-token cross-entropy over every position of the packed
-    batch (packing leaves no padding) + the MoE aux loss."""
-    logits, aux = forward(
+    batch (packing leaves no padding) + the MoE aux loss. With
+    ``diagnostics`` returns (loss, diag) — the has_aux shape
+    value_and_grad wants."""
+    out = forward(
         params, tokens, cfg, mesh, data_axis, seq_axis, pipe_axis,
-        expert_axis,
+        expert_axis, diagnostics=diagnostics,
     )
+    logits, aux = out[0], out[1]
     targets = tokens[:, 1:].astype(jnp.int32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ce = -jnp.mean(
         jnp.take_along_axis(logp, targets[..., None], axis=-1)
     )
-    return ce + cfg.moe_aux_weight * aux
+    loss = ce + cfg.moe_aux_weight * aux
+    if diagnostics:
+        return loss, out[2]
+    return loss
 
 
 def train_step(params, opt_state, tokens, cfg: LMConfig, tx, mesh=None,
                data_axis=None, seq_axis=None, pipe_axis=None,
-               expert_axis=None):
+               expert_axis=None, diagnostics: bool = False):
     """One optimizer step; jit this whole function (mesh static via
-    closure/partial)."""
-    loss, grads = jax.value_and_grad(loss_fn)(
-        params, tokens, cfg, mesh, data_axis, seq_axis, pipe_axis,
-        expert_axis,
-    )
+    closure/partial). Returns (params, opt_state, loss) — with
+    ``diagnostics``, (params, opt_state, loss, diag): the in-jit model
+    diagnostics ride the step's outputs, so reading them costs no extra
+    compilation or device round trip beyond fetching the tiny dict."""
+    if diagnostics:
+        (loss, diag), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, tokens, cfg, mesh, data_axis, seq_axis, pipe_axis,
+            expert_axis, diagnostics=True,
+        )
+    else:
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, tokens, cfg, mesh, data_axis, seq_axis, pipe_axis,
+            expert_axis,
+        )
     updates, opt_state = tx.update(grads, opt_state, params)
     params = jax.tree.map(lambda p, u: p + u, params, updates)
+    if diagnostics:
+        return params, opt_state, loss, diag
     return params, opt_state, loss
 
 

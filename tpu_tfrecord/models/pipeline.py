@@ -89,7 +89,7 @@ def microbatch_sharding(
 
 def _pipeline_local(
     params_stk, xs_local, *, stage_fn: StageFn, n_micro: int, n_stages: int,
-    block: int, axis: str,
+    block: int, axis: str, diagnostics: bool = False,
 ):
     """Per-device body (inside shard_map): params_stk is THIS stage's slice
     (leading dim 1); xs_local is THIS device's [R, mb, ...] block of the
@@ -105,6 +105,19 @@ def _pipeline_local(
         the owner (m // R) captures it ((m+1 thru S-1)-hop journey later)
         into its output shard. Invariant: at tick t device j holds the
         output injected at tick t - ((j+1) mod S).
+
+    ``diagnostics`` (static flag) additionally threads a per-tick
+    occupancy counter through the loop carry: stage s's compute at tick t
+    is USEFUL iff its microbatch m = t - s is real (0 <= m < n_micro —
+    the same predicate the capture mask enforces; warmup/drain ticks
+    compute garbage and count as bubble). The counter measures the
+    occupancy of THIS compiled schedule's loop, tick by tick — so a
+    rebuilt schedule (interleaved virtual stages, a different trip
+    count) changes the number automatically instead of someone
+    re-deriving a closed form. For this 1F1B construction it equals
+    (S-1)/(M+S-1) exactly (pinned by tests); it is identical on every
+    device, so no collective is needed and the gather-free HLO pin
+    survives with the flag on.
     """
     params = jax.tree.map(lambda a: a[0], params_stk)
     s = jax.lax.axis_index(axis)
@@ -129,7 +142,7 @@ def _pipeline_local(
         )
 
     def tick(t, state):
-        feed, act, ring, outbuf = state
+        feed, act, ring, outbuf, useful = state
         # feed ring: rotate toward stage 0, then inject this device's
         # next owned slice (m = t + s) the moment its travel time is due
         m_inj = t + s
@@ -147,7 +160,13 @@ def _pipeline_local(
         )
         outbuf = capture(t, ring, outbuf)
         act = jax.lax.ppermute(out, axis, fwd)  # hop to the next stage
-        return feed, act, ring, outbuf
+        if diagnostics:
+            # this tick computed microbatch m = t - s; useful iff real
+            m = t - s
+            useful = useful + jnp.where(
+                (m >= 0) & (m < n_micro), 1.0, 0.0
+            ).astype(jnp.float32)
+        return feed, act, ring, outbuf, useful
 
     def drain(t, state):
         # permute-only tail: the last S - 1 in-flight outputs finish their
@@ -157,15 +176,24 @@ def _pipeline_local(
         outbuf = capture(t, ring, outbuf)
         return ring, outbuf
 
-    _, _, ring, outbuf = jax.lax.fori_loop(
-        0, n_micro + n_stages - 1, tick, (feed0, act0, ring0, outbuf0)
+    _, _, ring, outbuf, useful = jax.lax.fori_loop(
+        0, n_micro + n_stages - 1, tick,
+        (feed0, act0, ring0, outbuf0, jnp.float32(0.0)),
     )
     if n_stages > 1:
         _, outbuf = jax.lax.fori_loop(
             n_micro + n_stages - 1, n_micro + 2 * n_stages - 2, drain,
             (ring, outbuf),
         )
-    return outbuf
+    if not diagnostics:
+        return outbuf
+    total = jnp.float32(n_micro + n_stages - 1)
+    useful = jax.lax.stop_gradient(useful)
+    return outbuf, {
+        "bubble_fraction": 1.0 - useful / total,
+        "useful_ticks": useful,
+        "total_ticks": total,
+    }
 
 
 def pipeline_apply(
@@ -175,7 +203,8 @@ def pipeline_apply(
     mesh: Mesh,
     pipe_axis: str = "pipe",
     batch_spec: P = P(),
-) -> jax.Array:
+    diagnostics: bool = False,
+):
     """Run M microbatches through S pipeline stages sharded on
     ``mesh[pipe_axis]``.
 
@@ -194,6 +223,16 @@ def pipeline_apply(
     the pipeline — the dp×pp composition); stage_fn then sees its
     (pipe, data)-local block and may itself use collectives over those
     axes, which are manual inside the same shard_map.
+
+    ``diagnostics`` (static flag) returns (out, diag) where diag carries
+    the bubble as THIS compiled schedule's loop pays it:
+    ``bubble_fraction`` (idle compute ticks / (M + S - 1) total, counted
+    per tick from the schedule's own occupancy predicate, so a rebuilt
+    schedule reports its own number — for 1F1B it equals the analytic
+    (S-1)/(M+S-1), pinned by tests; the baseline ROADMAP #2's
+    interleaved-V schedules must shrink), ``useful_ticks``,
+    ``total_ticks`` — f32 scalars, identical on every device (no
+    collective added: the HLO stays gather-free).
     """
     n_stages = mesh.shape[pipe_axis]
     leaves = jax.tree.leaves(stage_params)
@@ -215,14 +254,21 @@ def pipeline_apply(
         )
     tail = tuple(batch_spec) + (None,) * (xs.ndim - 1 - len(tuple(batch_spec)))
     spec = P(pipe_axis, *tail)
+    diag_spec = {
+        "bubble_fraction": P(), "useful_ticks": P(), "total_ticks": P(),
+    }
     fn = shard_map(
         functools.partial(
             _pipeline_local, stage_fn=stage_fn, n_micro=n_micro,
             n_stages=n_stages, block=block, axis=pipe_axis,
+            diagnostics=diagnostics,
         ),
         mesh=mesh,
         in_specs=(P(pipe_axis), spec),
-        out_specs=spec,
+        out_specs=(spec, diag_spec) if diagnostics else spec,
     )
+    if diagnostics:
+        out, diag = fn(stage_params, xs)
+        return (out[:n_micro] if padded != n_micro else out), diag
     out = fn(stage_params, xs)
     return out[:n_micro] if padded != n_micro else out

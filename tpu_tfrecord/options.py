@@ -146,7 +146,11 @@ class TFRecordOptions:
         lines, spool snapshots, and merged-trace track names (e.g.
         ``"reader"``, ``"decode_worker"``, ``"trainer"``). Default: the
         process's current trace-context role (``"main"`` unless a parent
-        propagated one).
+        propagated one). The ``"trainer"`` role is what the training
+        flight recorder spools under (examples/_harness.trainer_spool —
+        ``tfrecord_doctor train`` reports those processes' step-phase
+        shares + verdict, and the elastic dispatcher's
+        ``--scaler-roles trainer`` scopes its fleet verdict to them).
       - autotune: closed-loop knob tuning (tpu_tfrecord.autotune).
         ``"off"`` (default) keeps every knob static; ``"on"`` runs a
         controller at pulse boundaries that resizes the decode worker

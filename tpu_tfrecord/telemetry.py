@@ -808,6 +808,47 @@ def verdict_from_metrics(metrics=None, gauge: str = OCCUPANCY_GAUGE) -> str:
     return boundness_verdict(metrics.gauge_value(gauge))
 
 
+# ---------------------------------------------------------------------------
+# Training verdict (the trainer-side twin of the bound-ness verdict)
+# ---------------------------------------------------------------------------
+
+#: The step-phase decomposition the training harness records
+#: (examples/_harness.py StepPhases): disjoint wall-clock partitions of one
+#: train step. Stage names are ``train.<phase>``; windowed phase shares are
+#: published as ``train.share.<phase>`` gauges so the spool/doctor can read
+#: a trainer's recent regime, not its lifetime average.
+TRAIN_PHASES = ("data_wait", "h2d", "compute", "ckpt")
+TRAIN_STAGE_PREFIX = "train."
+TRAIN_SHARE_PREFIX = "train.share."
+
+#: Verdict thresholds: a step spending >= this fraction on checkpointing
+#: is ckpt_bound; >= this fraction on input (data_wait + h2d) is
+#: input_bound (the tf.data-style diagnosis that drives elastic scaling —
+#: an input_bound trainer wants more decode capacity, a compute_bound one
+#: is the goal state).
+TRAIN_CKPT_BOUND_SHARE = 0.25
+TRAIN_INPUT_BOUND_SHARE = 0.5
+
+
+def training_verdict(shares: Optional[Dict[str, float]]) -> str:
+    """``input_bound`` / ``compute_bound`` / ``ckpt_bound`` / ``unknown``
+    from a step-phase share mapping (keys = TRAIN_PHASES entries, values
+    fractions of step wall time; missing phases read as 0).
+
+    Checkpointing is checked first: a trainer drowning in ckpt writes is
+    ckpt_bound even when its input pipeline is also slow — the fix (async
+    or less frequent checkpoints) is different from "add decode workers",
+    so the louder-signal phase wins. ``unknown`` when no shares exist."""
+    if not shares or sum(shares.values()) <= 0:
+        return "unknown"
+    if shares.get("ckpt", 0.0) >= TRAIN_CKPT_BOUND_SHARE:
+        return "ckpt_bound"
+    input_share = shares.get("data_wait", 0.0) + shares.get("h2d", 0.0)
+    if input_share >= TRAIN_INPUT_BOUND_SHARE:
+        return "input_bound"
+    return "compute_bound"
+
+
 class OccupancyEma:
     """Shared smoothing for the bound-ness occupancy gauges: one EMA
     (alpha 0.2 — the verdict reflects the recent regime, not the epoch's
@@ -834,15 +875,28 @@ class OccupancyEma:
         return self.value
 
 
+#: Histogram families that hold DIMENSIONLESS values (fractions/ratios —
+#: the in-jit model diagnostics the training harness folds each step),
+#: not seconds: every ms-renderer must skip them, or a dropped-token
+#: fraction of 0.02 would print as "20ms of latency" on the fleet page.
+DIMENSIONLESS_HIST_PREFIXES = ("moe.", "pipeline.")
+
+
+def is_latency_hist(name: str) -> bool:
+    return not name.startswith(DIMENSIONLESS_HIST_PREFIXES)
+
+
 def quantiles_ms(source: Dict[str, Dict[str, float]]) -> Dict[str, Dict[str, float]]:
     """Convert a ``Metrics.quantiles()`` mapping — or any mapping whose
     entries carry ``p50_s``/``p90_s``/``p99_s`` (``snapshot()`` stage
     entries qualify) — into the shared milliseconds shape the pulse,
     bench, and doctor lines all emit, so their field sets cannot drift
-    apart. Entries without quantiles are skipped."""
+    apart. Entries without quantiles are skipped, as are the
+    DIMENSIONLESS diagnostic histograms (their values are fractions;
+    rendering them as milliseconds would lie)."""
     out: Dict[str, Dict[str, float]] = {}
     for name, q in sorted(source.items()):
-        if not q or "p50_s" not in q:
+        if not q or "p50_s" not in q or not is_latency_hist(name):
             continue
         entry = {
             "p50_ms": round(q["p50_s"] * 1e3, 3),
