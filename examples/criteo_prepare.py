@@ -75,7 +75,7 @@ def rows_to_batch(lines) -> ColumnarBatch:
 
 def generate_demo_tsv(path: str, rows: int = 20_000) -> None:
     rng = np.random.default_rng(0)
-    with open(path, "w") as fh:
+    with open(path, "w") as fh:  # graftlint: allow(atomic-write: demo input generator; a torn file is re-generated, never served)
         for _ in range(rows):
             parts = [str(int(rng.integers(0, 2)))]
             for _ in range(NUM_DENSE):

@@ -256,7 +256,7 @@ def main() -> None:
         donate_argnums=(0, 1),
     )
     snaps: dict = {}
-    digest_fh = open(args.digest_out, "a") if args.digest_out else None
+    digest_fh = open(args.digest_out, "a") if args.digest_out else None  # graftlint: allow(atomic-write: append-only one-line-per-step digest log; the kill -9 tests tolerate a torn tail line)
     last_diag: dict = {}
 
     def step_fn(state, gb):

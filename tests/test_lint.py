@@ -1,0 +1,926 @@
+"""graftlint: per-rule unit tests on synthetic violating/clean snippet
+twins, pragma + baseline mechanics, vocabulary drift both directions,
+lock-graph cycle detection, the HLO manifest (coverage + a deliberately
+gathered toy entrypoint), the tree-is-clean gate the acceptance criteria
+pin, and the ``tfrecord_doctor lint`` subcommand round trip.
+
+The synthetic-file tests exercise rules by writing small modules into a
+tmp dir and running the shared harness over them — file NAMES matter for
+the scoped rules (clock discipline applies to ``service.py``, not
+``other.py``), which is exactly how the tests pin the scoping.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.graftlint import (  # noqa: E402
+    DEFAULT_BASELINE,
+    REPO_ROOT,
+    run_lint,
+)
+from tools.graftlint.harness import (  # noqa: E402
+    RepoContext,
+    apply_baseline,
+    lint_paths,
+    load_baseline,
+)
+from tools.graftlint.rules import default_rules  # noqa: E402
+from tpu_tfrecord import vocabulary  # noqa: E402
+
+DOCTOR = os.path.join(REPO, "tools", "tfrecord_doctor.py")
+
+
+def lint_snippets(tmp_path, files, rules=None, readme=None):
+    """Write ``{name: source}`` into tmp_path and lint it. The README
+    check is pointed at the real repo README unless a test overrides it —
+    synthetic dirs should not trip vocab-docs by accident."""
+    for name, src in files.items():
+        p = tmp_path / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    repo = RepoContext(
+        str(tmp_path), readme=readme or os.path.join(REPO, "README.md")
+    )
+    findings, errors = lint_paths(
+        [str(tmp_path)], rules or default_rules(), str(tmp_path), repo=repo
+    )
+    assert not errors, errors
+    return findings
+
+
+def by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# clock-discipline
+# ---------------------------------------------------------------------------
+
+
+class TestClockDiscipline:
+    def test_bare_sleep_in_policy_module_flagged(self, tmp_path):
+        fs = lint_snippets(tmp_path, {
+            "service.py": """
+                import time
+                def wait_a_bit():
+                    time.sleep(0.2)
+            """,
+        })
+        (f,) = by_rule(fs, "clock-discipline")
+        assert "time.sleep" in f.message and f.line == 4
+
+    def test_injected_seam_twin_clean(self, tmp_path):
+        fs = lint_snippets(tmp_path, {
+            "elastic.py": """
+                import time
+                class Scaler:
+                    def __init__(self, clock=time.monotonic, sleep=time.sleep):
+                        self.clock = clock
+                        self.sleep = sleep
+                    def step(self):
+                        now = self.clock()
+                        self.sleep(0.1)
+                        return now
+            """,
+        })
+        assert not by_rule(fs, "clock-discipline")
+
+    def test_non_policy_module_out_of_scope(self, tmp_path):
+        fs = lint_snippets(tmp_path, {
+            "other.py": "import time\ntime.sleep(1)\n",
+        })
+        assert not by_rule(fs, "clock-discipline")
+
+    def test_all_three_calls_flagged(self, tmp_path):
+        fs = lint_snippets(tmp_path, {
+            "retry.py": """
+                import time
+                def f():
+                    return time.time(), time.monotonic()
+            """,
+        })
+        assert len(by_rule(fs, "clock-discipline")) == 2
+
+
+# ---------------------------------------------------------------------------
+# atomic-write
+# ---------------------------------------------------------------------------
+
+
+class TestAtomicWrite:
+    def test_bare_write_open_flagged(self, tmp_path):
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                def save(path, data):
+                    with open(path, "w") as fh:
+                        fh.write(data)
+            """,
+        })
+        (f,) = by_rule(fs, "atomic-write")
+        assert "atomic_write_bytes" in f.hint
+
+    def test_stage_then_replace_twin_clean(self, tmp_path):
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                import os
+                def save(path, data):
+                    tmp = path + ".tmp"
+                    with open(tmp, "wb") as fh:
+                        fh.write(data)
+                    os.replace(tmp, path)
+            """,
+        })
+        assert not by_rule(fs, "atomic-write")
+
+    def test_read_mode_ignored(self, tmp_path):
+        fs = lint_snippets(tmp_path, {
+            "mod.py": 'def load(p):\n    return open(p).read() + open(p, "rb").read().decode()\n',
+        })
+        assert not by_rule(fs, "atomic-write")
+
+    def test_truncating_plus_modes_flagged(self, tmp_path):
+        # "w+" tears the destination exactly like "w"; "r+" never truncates
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                def save(path, data):
+                    with open(path, "w+") as fh:
+                        fh.write(data)
+                def patch(path, data):
+                    with open(path, "r+") as fh:
+                        fh.write(data)
+            """,
+        })
+        flagged = by_rule(fs, "atomic-write")
+        assert len(flagged) == 1 and "'w+'" in flagged[0].message
+
+    def test_str_replace_does_not_exempt(self, tmp_path):
+        # only os.replace / an fs object's rename is a staging rename —
+        # string manipulation on an unrelated variable must not exempt
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                def save(path, data):
+                    name = path.replace(".json", ".txt")
+                    with open(path, "w") as fh:
+                        fh.write(data)
+            """,
+        })
+        assert len(by_rule(fs, "atomic-write")) == 1
+
+    def test_fs_object_rename_still_exempts(self, tmp_path):
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                def save(fs, path, data):
+                    stage = path + ".part"
+                    with open(stage, "wb") as fh:
+                        fh.write(data)
+                    fs.rename(stage, path)
+            """,
+        })
+        assert not by_rule(fs, "atomic-write")
+
+    def test_allow_pragma_suppresses_with_reason(self, tmp_path):
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                def mark(path):
+                    open(path, "wb").close()  # graftlint: allow(atomic-write: zero-byte marker)
+            """,
+        })
+        assert not by_rule(fs, "atomic-write")
+
+    def test_reasonless_allow_pragma_still_fails(self, tmp_path):
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                def mark(path):
+                    open(path, "wb").close()  # graftlint: allow(atomic-write:)
+            """,
+        })
+        (f,) = by_rule(fs, "atomic-write")
+        assert "no reason" in f.message
+
+
+# ---------------------------------------------------------------------------
+# lock-guard
+# ---------------------------------------------------------------------------
+
+_LOCKED_CLASS = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []      # init writes are pre-publication
+        def put(self, x):
+            with self._lock:
+                self._items.append(x)
+        def _drain_locked(self):
+            self._items.clear()   # *_locked convention: caller holds it
+        def size(self):
+            with self._lock:
+                return len(self._items)
+"""
+
+
+class TestLockGuard:
+    def test_unlocked_mutation_of_guarded_attr_flagged(self, tmp_path):
+        fs = lint_snippets(tmp_path, {
+            "mod.py": _LOCKED_CLASS + """
+        def reset(self):
+            self._items = []      # guarded attr, no lock: the race
+            """,
+        })
+        (f,) = by_rule(fs, "lock-guard")
+        assert "Box._items" in f.message and "reset" in f.message
+
+    def test_all_locked_twin_clean(self, tmp_path):
+        fs = lint_snippets(tmp_path, {"mod.py": _LOCKED_CLASS})
+        assert not by_rule(fs, "lock-guard")
+
+    def test_class_without_lock_contract_out_of_scope(self, tmp_path):
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                class Free:
+                    def __init__(self):
+                        self.items = []
+                    def put(self, x):
+                        self.items.append(x)
+            """,
+        })
+        assert not by_rule(fs, "lock-guard")
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+
+_INVERSION = """
+    import threading
+    a_lock = threading.Lock()
+    b_lock = threading.Lock()
+
+    def forward():
+        with a_lock:
+            with b_lock:
+                pass
+
+    def backward():
+        with b_lock:
+            with a_lock:
+                pass
+"""
+
+
+class TestLockOrder:
+    def test_constructed_inversion_is_a_cycle(self, tmp_path):
+        fs = lint_snippets(tmp_path, {"mod.py": _INVERSION})
+        (f,) = by_rule(fs, "lock-order")
+        assert "cycle" in f.message
+        assert "mod.a_lock" in f.message and "mod.b_lock" in f.message
+
+    def test_consistent_order_clean(self, tmp_path):
+        consistent = _INVERSION.replace(
+            "with b_lock:\n            with a_lock:",
+            "with a_lock:\n            with b_lock:",
+        )
+        fs = lint_snippets(tmp_path, {"mod.py": consistent})
+        assert not by_rule(fs, "lock-order")
+
+    def test_self_lock_nesting_is_a_self_deadlock(self, tmp_path):
+        """`with self._lock: with self._lock:` is the same instance by
+        construction — a guaranteed deadlock on a non-reentrant Lock,
+        reported as a self-loop cycle."""
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                import threading
+                class Box:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                    def oops(self):
+                        with self._lock:
+                            with self._lock:
+                                pass
+            """,
+        })
+        (f,) = by_rule(fs, "lock-order")
+        assert "mod.Box._lock" in f.message
+
+    def test_multi_item_with_contributes_edges(self, tmp_path):
+        """`with a_lock, b_lock:` acquires in item order — an inverted
+        nested acquisition elsewhere must still register as a cycle."""
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                import threading
+                a_lock = threading.Lock()
+                b_lock = threading.Lock()
+                def forward():
+                    with a_lock, b_lock:
+                        pass
+                def backward():
+                    with b_lock:
+                        with a_lock:
+                            pass
+            """,
+        })
+        (f,) = by_rule(fs, "lock-order")
+        assert "cycle" in f.message
+
+    def test_cross_module_cycle_detected(self, tmp_path):
+        fs = lint_snippets(tmp_path, {
+            "m1.py": """
+                import threading
+                a_lock = threading.Lock()
+                b_lock = threading.Lock()
+                def f():
+                    with a_lock:
+                        with b_lock:
+                            pass
+            """,
+            "m2.py": """
+                from m1 import a_lock, b_lock
+                def g():
+                    with b_lock:
+                        with a_lock:
+                            pass
+            """,
+        })
+        # conservative identity is module-scoped names, so the inversion
+        # must be constructed within matching ids to register — here each
+        # module contributes one edge under ITS name; no false cycle
+        assert not by_rule(fs, "lock-order")
+
+    def test_real_tree_lock_graph_is_acyclic(self):
+        result = run_lint(baseline=None)
+        assert not [
+            f for f in result["findings"] if f.rule == "lock-order"
+        ]
+
+
+# ---------------------------------------------------------------------------
+# except-swallow
+# ---------------------------------------------------------------------------
+
+
+class TestExceptSwallow:
+    def test_silent_swallow_flagged(self, tmp_path):
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                def f():
+                    try:
+                        risky()
+                    except Exception:
+                        pass
+            """,
+        })
+        (f,) = by_rule(fs, "except-swallow")
+        assert "swallow" in f.hint
+
+    @pytest.mark.parametrize("body,label", [
+        ("raise", "reraise"),
+        ("METRICS.count('mod.errors')", "counter"),
+    ])
+    def test_compliant_twins_clean(self, tmp_path, body, label):
+        fs = lint_snippets(tmp_path, {
+            "mod.py": f"""
+                def f():
+                    try:
+                        risky()
+                    except Exception:
+                        {body}
+            """,
+        })
+        assert not by_rule(fs, "except-swallow"), label
+
+    def test_swallow_pragma_with_reason_clean(self, tmp_path):
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                def f():
+                    try:
+                        risky()
+                    except Exception:  # graftlint: swallow(teardown path; nothing to report to)
+                        pass
+            """,
+        })
+        assert not by_rule(fs, "except-swallow")
+
+    def test_reasonless_swallow_pragma_flagged(self, tmp_path):
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                def f():
+                    try:
+                        risky()
+                    except Exception:  # graftlint: swallow()
+                        pass
+            """,
+        })
+        (f,) = by_rule(fs, "except-swallow")
+        assert "no reason" in f.message
+
+    def test_bare_except_and_base_exception_in_scope(self, tmp_path):
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                def f():
+                    try:
+                        risky()
+                    except BaseException:
+                        pass
+                def g():
+                    try:
+                        risky()
+                    except:
+                        pass
+            """,
+        })
+        assert len(by_rule(fs, "except-swallow")) == 2
+
+    def test_list_count_is_not_a_counter_bump(self, tmp_path):
+        # the receiver must look like a metrics registry — list.count /
+        # str.count in the handler must not satisfy the audit
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                def f(xs, x):
+                    try:
+                        risky()
+                    except Exception:
+                        n = xs.count(x)
+            """,
+        })
+        assert len(by_rule(fs, "except-swallow")) == 1
+
+    def test_raise_in_nested_def_does_not_comply(self, tmp_path):
+        # a raise inside a closure defined in the handler never fires on
+        # the except path
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                def f():
+                    try:
+                        risky()
+                    except Exception:
+                        def later():
+                            raise RuntimeError("not on this path")
+            """,
+        })
+        assert len(by_rule(fs, "except-swallow")) == 1
+
+    def test_narrow_except_out_of_scope(self, tmp_path):
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                def f():
+                    try:
+                        risky()
+                    except (OSError, ValueError):
+                        pass
+            """,
+        })
+        assert not by_rule(fs, "except-swallow")
+
+
+# ---------------------------------------------------------------------------
+# vocabulary: call sites and docs, drift in BOTH directions
+# ---------------------------------------------------------------------------
+
+
+class TestVocabulary:
+    def test_unregistered_counter_name_flagged(self, tmp_path):
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                from tpu_tfrecord.metrics import METRICS
+                METRICS.count("bogus.name")
+            """,
+        })
+        (f,) = by_rule(fs, "vocab-unregistered")
+        assert "bogus.name" in f.message
+
+    def test_registered_names_clean_and_set_add_not_confused(self, tmp_path):
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                from tpu_tfrecord.metrics import METRICS
+                METRICS.count("cache.hits")
+                METRICS.gauge("prefetch.occupancy", 0.5)
+                seen = set()
+                seen.add("not a metric name")   # receiver is not a registry
+            """,
+        })
+        assert not by_rule(fs, "vocab-unregistered")
+
+    def test_dynamic_fstring_prefix_checked(self, tmp_path):
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                from tpu_tfrecord.metrics import METRICS
+                def f(knob, v):
+                    METRICS.gauge(f"autotune.{knob}", v)    # registered prefix
+                    METRICS.gauge(f"mystery.{knob}", v)     # not registered
+            """,
+        })
+        (f,) = by_rule(fs, "vocab-unregistered")
+        assert "mystery." in f.message
+
+    def test_derived_errors_suffix_is_registered(self):
+        assert vocabulary.is_registered("decode.errors", "counter")
+        assert not vocabulary.is_registered("nonexistent.errors", "counter")
+
+    def test_kind_matters(self):
+        assert vocabulary.is_registered("cache.hits", "counter")
+        assert not vocabulary.is_registered("cache.hits", "gauge")
+
+    def test_readme_block_matches_registry(self):
+        # docs-drift direction 1: the committed README block is current
+        result = run_lint(baseline=None)
+        assert not [f for f in result["findings"] if f.rule == "vocab-docs"]
+
+    def test_stale_readme_block_flagged(self, tmp_path):
+        # docs-drift direction 2: remove one registered name from the
+        # block and the rule names the drifted entry
+        readme = tmp_path / "README.md"
+        block = vocabulary.vocabulary_markdown()
+        assert "| `cache.hits` |" in block
+        stale = "\n".join(
+            ln for ln in block.splitlines() if "`cache.hits`" not in ln
+        )
+        readme.write_text("# doc\n\n" + stale + "\n")
+        fs = lint_snippets(
+            tmp_path, {"mod.py": "x = 1\n"}, readme=str(readme)
+        )
+        (f,) = by_rule(fs, "vocab-docs")
+        assert "stale" in f.message and "cache.hits" in f.message
+
+    def test_missing_readme_block_flagged(self, tmp_path):
+        readme = tmp_path / "README.md"
+        readme.write_text("# no block here\n")
+        fs = lint_snippets(
+            tmp_path, {"mod.py": "x = 1\n"}, readme=str(readme)
+        )
+        (f,) = by_rule(fs, "vocab-docs")
+        assert "no generated vocabulary block" in f.message
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics
+# ---------------------------------------------------------------------------
+
+_VIOLATION = """
+    def f():
+        try:
+            risky()
+        except Exception:
+            pass
+"""
+
+
+class TestBaseline:
+    def _findings(self, tmp_path):
+        return lint_snippets(tmp_path, {"mod.py": _VIOLATION})
+
+    def test_new_finding_fails(self, tmp_path):
+        fs = self._findings(tmp_path)
+        base = tmp_path / "baseline.txt"
+        base.write_text("# empty baseline: nothing grandfathered\n")
+        new, stale = apply_baseline(fs, load_baseline(str(base)))
+        assert new and not stale
+
+    def test_baselined_finding_passes(self, tmp_path):
+        fs = self._findings(tmp_path)
+        base = tmp_path / "baseline.txt"
+        base.write_text(
+            "# justified: synthetic grandfather\n"
+            + "\n".join(f.key for f in fs) + "\n"
+        )
+        new, stale = apply_baseline(fs, load_baseline(str(base)))
+        assert not new and not stale
+
+    def test_stale_baseline_entry_warns(self, tmp_path):
+        fs = self._findings(tmp_path)
+        base = tmp_path / "baseline.txt"
+        base.write_text(
+            "# one real, one stale\n"
+            + "\n".join(f.key for f in fs)
+            + "\nexcept-swallow\tgone.py\texcept@f#0\n"
+        )
+        new, stale = apply_baseline(fs, load_baseline(str(base)))
+        assert not new
+        assert stale == ["except-swallow\tgone.py\texcept@f#0"]
+
+    def test_baseline_key_stable_under_line_drift(self, tmp_path):
+        fs1 = lint_snippets(tmp_path, {"mod.py": _VIOLATION})
+        shifted = "# a new leading comment\n\n\n" + textwrap.dedent(_VIOLATION)
+        (tmp_path / "mod.py").write_text(shifted)
+        repo = RepoContext(
+            str(tmp_path), readme=os.path.join(REPO, "README.md")
+        )
+        fs2, _ = lint_paths(
+            [str(tmp_path)], default_rules(), str(tmp_path), repo=repo
+        )
+        k1 = [f.key for f in fs1 if f.rule == "except-swallow"]
+        k2 = [f.key for f in fs2 if f.rule == "except-swallow"]
+        assert k1 == k2
+        assert [f.line for f in fs1 if f.rule == "except-swallow"] != [
+            f.line for f in fs2 if f.rule == "except-swallow"
+        ]
+
+
+# ---------------------------------------------------------------------------
+# the tree itself: the acceptance pins
+# ---------------------------------------------------------------------------
+
+
+class TestTreeIsClean:
+    """`python -m tools.graftlint` exits 0 on the tree; deleting any single
+    baseline line or reverting any one of this PR's violation fixes makes
+    it exit 1 — the acceptance criteria, demonstrated in-process."""
+
+    def test_tree_clean_against_committed_baseline(self):
+        result = run_lint()
+        assert result["findings"] == [], [
+            f.format() for f in result["findings"]
+        ]
+        assert result["errors"] == []
+        assert result["stale_baseline"] == []
+        # the baseline absorbs exactly the justified grandfathers
+        assert result["baselined"] == len(
+            [
+                k for k in load_baseline(DEFAULT_BASELINE).elements()
+            ]
+        )
+
+    def test_deleting_any_single_baseline_line_fails(self, tmp_path):
+        entries = list(load_baseline(DEFAULT_BASELINE).elements())
+        assert entries, "committed baseline unexpectedly empty"
+        for i in range(len(entries)):
+            kept = entries[:i] + entries[i + 1 :]
+            b = tmp_path / f"baseline_{i}.txt"
+            b.write_text("\n".join(kept) + "\n")
+            result = run_lint(baseline=str(b))
+            assert len(result["findings"]) == 1, (
+                i, [f.format() for f in result["findings"]],
+            )
+            assert result["findings"][0].key == entries[i]
+
+    def test_reverting_the_service_clock_fix_fails(self, tmp_path):
+        src = open(os.path.join(REPO, "tpu_tfrecord", "service.py")).read()
+        assert "stop_event.wait(0.2)" in src  # the PR's fix
+        reverted = src.replace(
+            "while not stop_event.wait(0.2):\n            pass",
+            "while not stop_event.is_set():\n            time.sleep(0.2)",
+        )
+        assert reverted != src
+        (tmp_path / "service.py").write_text(reverted)
+        fs = lint_snippets(tmp_path, {})  # files already written
+        assert by_rule(fs, "clock-discipline")
+
+    def test_removing_a_swallow_pragma_fails(self, tmp_path):
+        src = open(os.path.join(REPO, "tpu_tfrecord", "elastic.py")).read()
+        assert "# graftlint: swallow(" in src
+        import re
+
+        reverted = re.sub(r"\s*# graftlint: swallow\([^\n]*\)", "", src, count=1)
+        (tmp_path / "elastic.py").write_text(reverted)
+        fs = lint_snippets(tmp_path, {})
+        assert by_rule(fs, "except-swallow")
+
+
+# ---------------------------------------------------------------------------
+# HLO contract manifest
+# ---------------------------------------------------------------------------
+
+
+class TestHloManifest:
+    def test_manifest_covers_the_required_entrypoints(self):
+        from tools.graftlint import hlo_contracts as hc
+
+        # acceptance: >= 4 jitted entrypoints, reproducing every
+        # historical collective pin exactly
+        assert len(hc.CONTRACTS) >= 4
+        want = {
+            "pipeline_feed_ring": (
+                ("collective-permute",),
+                ("all-gather", "all-reduce", "all-to-all"),
+            ),
+            "pipeline_feed_ring_dp": (("collective-permute",), ("all-gather",)),
+            "pipeline_diagnostics": (("collective-permute",), ("all-gather",)),
+            "moe_apply_ep": (("all-to-all",), ("all-gather",)),
+            "moe_apply_ep_diagnostics": (("all-to-all",), ("all-gather",)),
+            "lm_train_step": (("collective-permute",), ("all-gather",)),
+        }
+        for name, (contains, absent) in want.items():
+            c = hc.get(name)
+            assert tuple(c.contains) == contains, name
+            assert tuple(c.absent) == absent, name
+        # diagnostics on AND off variants both present
+        assert any(c.diagnostics for c in hc.CONTRACTS.values())
+        assert any(not c.diagnostics for c in hc.CONTRACTS.values())
+
+    def test_unknown_contract_is_loud(self):
+        from tools.graftlint import hlo_contracts as hc
+
+        with pytest.raises(KeyError, match="unknown HLO contract"):
+            hc.get("nope")
+
+    def test_manifest_catches_a_deliberately_gathered_toy(self):
+        """The driver must FAIL a function that all-gathers: jit an
+        identity whose output is replicated from a sharded input — the
+        partitioner has to materialize an all-gather."""
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tools.graftlint import hlo_contracts as hc
+        from tpu_tfrecord.tpu import create_mesh
+
+        def toy_builder():
+            mesh = create_mesh({"x": 4}, jax.devices()[:4])
+            x = jax.device_put(
+                jnp.zeros((8, 8), jnp.float32),
+                NamedSharding(mesh, P("x", None)),
+            )
+            fn = jax.jit(
+                lambda x: x * 2.0,
+                out_shardings=NamedSharding(mesh, P()),
+            )
+            return fn, (x,)
+
+        toy = hc.HloContract(
+            name="gathered_toy",
+            entrypoint="<toy>",
+            contains=(),
+            absent=("all-gather",),
+            builder=toy_builder,
+        )
+        with pytest.raises(AssertionError, match="forbidden 'all-gather'"):
+            hc.verify(toy)
+        # and the same toy under a permissive contract passes: the failure
+        # above is the contract, not the harness
+        ok = dataclasses.replace(toy, absent=(), contains=("all-gather",))
+        hc.verify(ok)
+
+
+# ---------------------------------------------------------------------------
+# CLI + doctor subcommand
+# ---------------------------------------------------------------------------
+
+
+def _write_violating_dir(tmp_path):
+    d = tmp_path / "proj"
+    d.mkdir()
+    (d / "mod.py").write_text(textwrap.dedent(_VIOLATION))
+    return d
+
+
+class TestCli:
+    def test_module_cli_clean_tree_exit_0(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert out.returncode == 0, (out.stdout, out.stderr)
+        summary = json.loads(out.stdout.strip().splitlines()[-1])
+        assert summary["findings"] == 0 and summary["errors"] == 0
+
+    def test_module_cli_findings_exit_1(self, tmp_path):
+        d = _write_violating_dir(tmp_path)
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", str(d)],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert out.returncode == 1, (out.stdout, out.stderr)
+        assert "except-swallow" in out.stdout
+
+    def test_module_cli_unreadable_exit_2(self, tmp_path):
+        out = subprocess.run(
+            [
+                sys.executable, "-m", "tools.graftlint",
+                str(tmp_path / "does_not_exist"),
+            ],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert out.returncode == 2, (out.stdout, out.stderr)
+
+    def test_syntax_error_is_exit_2_not_crash(self, tmp_path):
+        d = tmp_path / "proj"
+        d.mkdir()
+        (d / "bad.py").write_text("def broken(:\n")
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", str(d)],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert out.returncode == 2, (out.stdout, out.stderr)
+        assert "bad.py" in out.stdout
+
+    def test_write_baseline_keeps_already_baselined_keys(self, tmp_path):
+        """--write-baseline must see EVERY finding: filtering through the
+        existing baseline first would rewrite the file with only the NEW
+        keys, so the very next plain run fails on the dropped ones."""
+        d = tmp_path / "proj"
+        d.mkdir()
+        (d / "mod.py").write_text(textwrap.dedent(_VIOLATION))
+        base = tmp_path / "base.txt"
+
+        def graft(*extra):
+            return subprocess.run(
+                [
+                    sys.executable, "-m", "tools.graftlint", str(d),
+                    "--baseline", str(base), *extra,
+                ],
+                capture_output=True, text=True, cwd=REPO,
+            )
+
+        assert graft("--write-baseline").returncode == 0
+        assert graft().returncode == 0  # first key grandfathered
+        (d / "mod2.py").write_text(textwrap.dedent(_VIOLATION))
+        assert graft().returncode == 1  # second violation is NEW
+        assert graft("--write-baseline").returncode == 0
+        keys = [
+            l for l in base.read_text().splitlines()
+            if l.strip() and not l.startswith("#")
+        ]
+        assert len(keys) == 2, keys  # both keys kept, none dropped
+        assert graft().returncode == 0
+
+    def test_vocab_md_matches_registry(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", "--vocab-md"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert out.returncode == 0
+        assert out.stdout.strip() == vocabulary.vocabulary_markdown().strip()
+
+
+class TestDoctorLint:
+    def test_clean_tree_exit_0(self):
+        out = subprocess.run(
+            [sys.executable, DOCTOR, "lint"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert out.returncode == 0, (out.stdout, out.stderr)
+        lines = [json.loads(l) for l in out.stdout.splitlines() if l.strip()]
+        assert lines[-1]["event"] == "lint"
+        assert lines[-1]["findings"] == 0
+
+    def test_findings_exit_1_with_finding_events(self, tmp_path):
+        d = _write_violating_dir(tmp_path)
+        out = subprocess.run(
+            [sys.executable, DOCTOR, "lint", str(d)],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert out.returncode == 1, (out.stdout, out.stderr)
+        lines = [json.loads(l) for l in out.stdout.splitlines() if l.strip()]
+        kinds = [l["event"] for l in lines]
+        assert "finding" in kinds and kinds[-1] == "lint"
+
+    def test_unreadable_exit_2(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, DOCTOR, "lint", str(tmp_path / "nope")],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert out.returncode == 2, (out.stdout, out.stderr)
+
+    @pytest.mark.parametrize("scenario", ["clean", "findings"])
+    def test_json_round_trips_text(self, tmp_path, scenario):
+        """--json emits ONE document whose events mirror the text lines
+        exactly (same objects, same order, same exit code) — the
+        _Emitter contract fleet/train/serve-status already pin."""
+        args = [sys.executable, DOCTOR, "lint"]
+        if scenario == "findings":
+            args.append(str(_write_violating_dir(tmp_path)))
+        text = subprocess.run(
+            args, capture_output=True, text=True, cwd=REPO
+        )
+        doc = subprocess.run(
+            args + ["--json"], capture_output=True, text=True, cwd=REPO
+        )
+        assert text.returncode == doc.returncode
+        text_events = [
+            json.loads(l) for l in text.stdout.splitlines() if l.strip()
+        ]
+        doc_events = json.loads(doc.stdout)["events"]
+        assert doc_events == text_events
+
+
+# ---------------------------------------------------------------------------
+# vocabulary registry internals
+# ---------------------------------------------------------------------------
+
+
+class TestVocabularyRegistry:
+    def test_every_registered_name_in_markdown(self):
+        md = vocabulary.vocabulary_markdown()
+        for name in vocabulary.registered_names():
+            assert f"`{name}`" in md, name
+
+    def test_kinds_cover_the_flagship_names(self):
+        assert "train.steps" in vocabulary.COUNTERS
+        assert "decode" in vocabulary.STAGES
+        assert "prefetch.occupancy" in vocabulary.GAUGES
+        assert "autotune.adjust" in vocabulary.SPANS
+
+    def test_dynamic_prefixes_cover_autotune_and_train(self):
+        assert vocabulary.is_registered("autotune.workers", "gauge")
+        assert vocabulary.is_registered("train.share.compute", "gauge")
+        assert vocabulary.is_registered("train.data_wait", "stage")

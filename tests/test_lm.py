@@ -11,9 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax.sharding import NamedSharding, PartitionSpec as P
 
-from hlo_util import assert_hlo
+from tools.graftlint import hlo_contracts
 from tpu_tfrecord.models import lm
 from tpu_tfrecord.tpu import TokenPacker, create_mesh
 
@@ -183,35 +182,9 @@ class TestTraining:
         """The acceptance pin, at the TRAIN-STEP level: the compiled dp×pp
         step moves activations by collective-permute and never all-gathers
         the microbatch stream (grads over 'data' still all-reduce — that
-        is dp's collective, not the pipeline's)."""
-        cfg = lm.LMConfig(
-            vocab_size=64, d_model=16, n_heads=2, n_layers=4, max_len=16,
-            n_micro=4,
-        )
-        mesh = create_mesh({"pipe": 4, "data": 2})
-        params = lm.init_params(jax.random.key(0), cfg)
-        p_sh = jax.device_put(
-            params, lm.param_shardings(mesh, params, pipe_axis="pipe")
-        )
-        tx = optax.sgd(1e-2)
-        opt = jax.device_put(
-            tx.init(params),
-            jax.tree.map(
-                lambda _: NamedSharding(mesh, P()), tx.init(params)
-            ),
-        )
-        toks = batch(cfg)
-        step = jax.jit(
-            functools.partial(
-                lm.train_step, cfg=cfg, tx=tx, mesh=mesh, data_axis="data",
-                pipe_axis="pipe",
-            )
-        )
-        assert_hlo(
-            step, (p_sh, opt, toks),
-            contains=["collective-permute"],
-            absent=["all-gather"],
-        )
+        is dp's collective, not the pipeline's). Pin + construction live
+        in the shared manifest."""
+        hlo_contracts.verify("lm_train_step")
 
 
 class TestTokenPacker:

@@ -7,7 +7,8 @@ import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from hlo_util import assert_hlo, per_device_argument_bytes
+from hlo_util import per_device_argument_bytes
+from tools.graftlint import hlo_contracts
 from tpu_tfrecord.models import pipeline
 from tpu_tfrecord.tpu import create_mesh
 
@@ -105,17 +106,10 @@ class TestScaleShape:
     def test_hlo_collective_permute_no_gather_no_reduce(self):
         """Activation/feed/output movement must be neighbor permutes of ONE
         microbatch slice: no all-gather of the stream, and no all-reduce —
-        the old full-[M, mb, ...] psum broadcast is gone."""
-        mesh = create_mesh({"pipe": 4}, jax.devices()[:4])
-        params, stage_fn = make_stages()
-        xs = jnp.zeros((4, 2, 8), jnp.float32)
-        p_sh, xs_sh = sharded_args(mesh, params, xs)
-        assert_hlo(
-            self._jitted(mesh, stage_fn),
-            (p_sh, xs_sh),
-            contains=["collective-permute"],
-            absent=["all-gather", "all-reduce", "all-to-all"],
-        )
+        the old full-[M, mb, ...] psum broadcast is gone. The pin (required
+        and forbidden collectives AND the canonical construction) lives in
+        the shared manifest — this test is its tier-1 driver."""
+        hlo_contracts.verify("pipeline_feed_ring")
 
     def test_per_device_input_flat_as_pipeline_grows(self):
         """Weak scaling — the scale shape itself: grow the machine (S) and
@@ -235,23 +229,5 @@ class TestDpPpComposition:
             )
 
     def test_composed_hlo_still_gather_free(self):
-        mesh = create_mesh({"pipe": 4, "data": 2})
-        params, stage_fn = make_stages()
-        xs = jnp.zeros((8, 4, 8), jnp.float32)
-        p_sh = jax.device_put(params, NamedSharding(mesh, P("pipe")))
-        xs_sh = jax.device_put(
-            xs,
-            pipeline.microbatch_sharding(
-                mesh, ndim=xs.ndim, batch_spec=P("data")
-            ),
-        )
-        assert_hlo(
-            jax.jit(
-                lambda p, xs: pipeline.pipeline_apply(
-                    stage_fn, p, xs, mesh, batch_spec=P("data")
-                )
-            ),
-            (p_sh, xs_sh),
-            contains=["collective-permute"],
-            absent=["all-gather"],
-        )
+        """dp×pp composition pin, from the shared manifest."""
+        hlo_contracts.verify("pipeline_feed_ring_dp")

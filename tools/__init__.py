@@ -1,0 +1,2 @@
+"""Repo tooling (``python -m tools.graftlint``). Not shipped in the wheel
+(pyproject packages.find includes only ``tpu_tfrecord*``)."""

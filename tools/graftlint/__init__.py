@@ -1,0 +1,107 @@
+"""graftlint — the repo's AST + HLO invariant checker.
+
+Six PRs of review-hardening notes were one recurring failure class:
+invariants held by convention drift silently until a reviewer catches
+them. graftlint turns those conventions into CI-enforced rules over a
+shared visitor harness (stdlib ``ast`` only, no new deps):
+
+====================  ======================================================
+rule id               invariant
+====================  ======================================================
+clock-discipline      policy/controller modules (autotune, elastic, retry,
+                      stall, fleet, service) never call bare
+                      ``time.time/monotonic/sleep`` — decisions go through
+                      the injected clock/sleep seams
+atomic-write          persisted artifacts are written atomically
+                      (``telemetry.atomic_write_bytes`` or stage + replace)
+lock-guard            attributes a ``_lock``-contract class mutates under
+                      the lock are never mutated outside it
+lock-order            the static lock-acquisition graph across the
+                      lock-using modules is acyclic (no order inversions)
+except-swallow        every broad ``except Exception`` re-raises, bumps a
+                      counter, or carries ``# graftlint: swallow(reason)``
+vocab-unregistered    metric/span call sites use names registered in
+                      tpu_tfrecord/vocabulary.py
+vocab-docs            the README vocabulary block matches the registry
+hlo-contract          (``--hlo``) every manifest row in hlo_contracts.py
+                      compiles with its required collectives present and
+                      its forbidden ones absent
+====================  ======================================================
+
+Run ``python -m tools.graftlint`` (defaults: ``tpu_tfrecord tools
+examples`` against the committed ``tools/graftlint/baseline.txt``), or
+``tfrecord_doctor lint``. Findings are ``file:line rule-id message
+(fix: hint)``; CI fails only on NEW (non-baselined) findings, and stale
+baseline entries warn so grandfathered debt shrinks monotonically.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Optional
+
+from tools.graftlint.harness import (
+    Finding,
+    apply_baseline,
+    lint_paths,
+    load_baseline,
+)
+from tools.graftlint.rules import default_rules
+
+__all__ = [
+    "Finding",
+    "run_lint",
+    "DEFAULT_PATHS",
+    "DEFAULT_BASELINE",
+    "REPO_ROOT",
+]
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+DEFAULT_PATHS = ("tpu_tfrecord", "tools", "examples")
+DEFAULT_BASELINE = os.path.join(
+    REPO_ROOT, "tools", "graftlint", "baseline.txt"
+)
+
+
+def run_lint(
+    paths: Optional[Iterable[str]] = None,
+    baseline: Optional[str] = DEFAULT_BASELINE,
+    root: str = REPO_ROOT,
+    hlo: bool = False,
+    rules=None,
+) -> Dict:
+    """The one entry point the CLI, the doctor subcommand, and the tier-1
+    test all call. Returns::
+
+        {"findings": [Finding...],   # new (non-baselined) findings
+         "baselined": int,           # findings the baseline absorbed
+         "stale_baseline": [key...], # baseline entries with no live match
+         "errors": [str...],         # unreadable/unparseable inputs
+         "hlo": [dict...]}           # --hlo contract results (may be [])
+
+    Exit-code policy (callers): errors -> 2, findings or failed HLO
+    contracts -> 1, else 0; stale baseline entries WARN but do not fail.
+    """
+    paths = list(paths) if paths else list(DEFAULT_PATHS)
+    findings, errors = lint_paths(paths, rules or default_rules(), root)
+    baselined = 0
+    stale: List[str] = []
+    if baseline and os.path.exists(baseline):
+        base = load_baseline(baseline)
+        new, stale = apply_baseline(findings, base)
+        baselined = len(findings) - len(new)
+        findings = new
+    hlo_results: List[Dict] = []
+    if hlo:
+        from tools.graftlint import hlo_contracts
+
+        hlo_results = hlo_contracts.check_contracts()
+    return {
+        "findings": findings,
+        "baselined": baselined,
+        "stale_baseline": stale,
+        "errors": errors,
+        "hlo": hlo_results,
+    }

@@ -1,0 +1,150 @@
+"""CLI: ``python -m tools.graftlint [paths...]``.
+
+Exit 0 = clean (baseline entries absorbed, stale entries at most warn);
+1 = new findings (or failed HLO contracts under ``--hlo``);
+2 = an input could not be read/parsed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from tools.graftlint import DEFAULT_BASELINE, DEFAULT_PATHS, REPO_ROOT, run_lint
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="AST + HLO invariant checker (see tools/graftlint/__init__.py)",
+    )
+    ap.add_argument(
+        "paths", nargs="*", default=None,
+        help=f"files/dirs to lint (default: {' '.join(DEFAULT_PATHS)})",
+    )
+    ap.add_argument(
+        "--baseline", default=DEFAULT_BASELINE, metavar="PATH",
+        help="baseline file of grandfathered finding keys "
+        "(default: tools/graftlint/baseline.txt)",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, including baselined ones",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help='emit one JSON document {"events": [...]} instead of text lines',
+    )
+    ap.add_argument(
+        "--hlo", action="store_true",
+        help="also compile and check every HLO collective contract "
+        "(needs jax + an 8-device CPU platform; slow)",
+    )
+    ap.add_argument(
+        "--vocab-md", action="store_true",
+        help="print the generated README vocabulary block and exit",
+    )
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="write the current finding keys to --baseline (justify each "
+        "with a # comment before committing) and exit 0",
+    )
+    args = ap.parse_args(argv)
+
+    if args.vocab_md:
+        sys.path.insert(0, REPO_ROOT)
+        from tpu_tfrecord.vocabulary import vocabulary_markdown
+
+        sys.stdout.write(vocabulary_markdown() + "\n")
+        return 0
+
+    try:
+        result = run_lint(
+            paths=args.paths or None,
+            # --write-baseline must see EVERY finding: filtering through
+            # the existing baseline first would rewrite the file with only
+            # the new findings, silently dropping the already-grandfathered
+            # keys (and their hand-written justifications) so the very next
+            # plain run fails
+            baseline=(
+                None
+                if (args.no_baseline or args.write_baseline)
+                else args.baseline
+            ),
+            hlo=args.hlo,
+        )
+    except FileNotFoundError as e:
+        sys.stderr.write(f"graftlint: {e}\n")
+        return 2
+
+    if args.write_baseline:
+        lines = ["# graftlint baseline: one key per line, each preceded by"]
+        lines.append("# a one-line justification comment. Stale entries warn.")
+        for f in result["findings"]:
+            lines.append("# TODO: justify this grandfathered finding")
+            lines.append(f.key)
+        tmp = args.baseline + f".tmp-{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write("\n".join(lines) + "\n")
+        os.replace(tmp, args.baseline)
+        sys.stderr.write(
+            f"graftlint: wrote {len(result['findings'])} keys to "
+            f"{args.baseline}\n"
+        )
+        return 0
+
+    events = [f.to_json() for f in result["findings"]]
+    for key in result["stale_baseline"]:
+        events.append({"event": "stale_baseline", "key": key})
+    for err in result["errors"]:
+        events.append({"event": "error", "error": err})
+    for entry in result["hlo"]:
+        events.append({"event": "hlo_contract", **entry})
+    hlo_failed = [e for e in result["hlo"] if not e["ok"] and not e["skipped"]]
+    summary = {
+        "event": "lint",
+        "findings": len(result["findings"]),
+        "baselined": result["baselined"],
+        "stale_baseline": len(result["stale_baseline"]),
+        "errors": len(result["errors"]),
+        "hlo_checked": len(result["hlo"]),
+        "hlo_failed": len(hlo_failed),
+    }
+    events.append(summary)
+
+    if args.json:
+        sys.stdout.write(json.dumps({"events": events}, sort_keys=True) + "\n")
+    else:
+        for f in result["findings"]:
+            sys.stdout.write(f.format() + "\n")
+        for key in result["stale_baseline"]:
+            sys.stdout.write(
+                f"warning: stale baseline entry (no matching finding; "
+                f"delete it): {key!r}\n"
+            )
+        for err in result["errors"]:
+            sys.stdout.write(f"error: {err}\n")
+        for entry in result["hlo"]:
+            status = (
+                "OK" if entry["ok"]
+                else "SKIPPED" if entry["skipped"]
+                else "FAILED"
+            )
+            line = f"hlo-contract {entry['name']} {status}"
+            if entry["error"]:
+                line += f": {entry['error']}"
+            sys.stdout.write(line + "\n")
+        sys.stdout.write(json.dumps(summary, sort_keys=True) + "\n")
+
+    if result["errors"]:
+        return 2
+    if result["findings"] or hlo_failed:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,376 @@
+"""graftlint's shared machinery: one AST walk per file, rules as
+subscribers, pragma suppression, and the committed-baseline protocol.
+
+Findings are ``file:line rule-id message (fix: hint)`` lines. Every
+finding also carries a BASELINE KEY that is stable under line-number
+drift (rule id + path + a rule-chosen detail such as the enclosing
+function), so a committed baseline survives unrelated edits to the same
+file. Baseline entries are the keys verbatim, one per line, each
+preceded by a ``#`` justification comment; a baseline entry with no
+matching finding is STALE and warns (the violation it grandfathers is
+gone — delete the entry), while a finding with no baseline entry fails.
+
+Pragmas: ``# graftlint: allow(<rule-id>: <reason>)`` on the flagged line
+(or the line just above/below, for multi-line statements) suppresses one
+rule at one site; the exception-audit rule additionally honors its own
+``# graftlint: swallow(<reason>)`` spelling as documented compliance
+rather than suppression. Reasons are mandatory — a bare pragma is itself
+a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "Finding",
+    "FileContext",
+    "Rule",
+    "RepoContext",
+    "walk_file",
+    "lint_paths",
+    "load_baseline",
+    "apply_baseline",
+    "iter_python_files",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+    hint: str = ""
+    detail: str = ""  # rule-chosen stable fragment of the baseline key
+
+    @property
+    def key(self) -> str:
+        """Baseline key: line-number-free so the baseline survives edits
+        elsewhere in the file."""
+        return f"{self.rule}\t{self.path}\t{self.detail or self.message}"
+
+    def format(self) -> str:
+        out = f"{self.path}:{self.line} {self.rule} {self.message}"
+        if self.hint:
+            out += f" (fix: {self.hint})"
+        return out
+
+    def to_json(self) -> Dict:
+        return {
+            "event": "finding",
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "hint": self.hint,
+            "key": self.key,
+        }
+
+
+# greedy body match: reasons may themselves contain parentheses
+# ("counted in _kill (cache.populate_errors)") — the pragma runs to the
+# LAST closing paren on the line
+_PRAGMA_RE = re.compile(r"#\s*graftlint:\s*([\w-]+)\((.*)\)")
+
+
+class FileContext:
+    """One parsed source file plus the line-level pragma index."""
+
+    def __init__(self, path: str, rel_path: str, source: str):
+        self.path = path
+        self.rel = rel_path.replace(os.sep, "/")
+        self.name = os.path.basename(path)
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line -> [(action, reason)]
+        self.pragmas: Dict[int, List[Tuple[str, str]]] = {}
+        for i, text in enumerate(self.lines, 1):
+            if "graftlint" not in text:
+                continue
+            for m in _PRAGMA_RE.finditer(text):
+                self.pragmas.setdefault(i, []).append(
+                    (m.group(1), m.group(2).strip())
+                )
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def pragma(self, lineno: int, action: str) -> Optional[str]:
+        """The reason string of an ``action`` pragma on ``lineno`` or its
+        immediate neighbors (multi-line statements put the comment where
+        it fits), or None. An empty reason returns "" — callers treat
+        that as its own violation."""
+        for ln in (lineno, lineno - 1, lineno + 1):
+            for act, reason in self.pragmas.get(ln, ()):
+                if act == action:
+                    return reason
+        return None
+
+    def allow_pragma(self, lineno: int, rule_id: str) -> Optional[str]:
+        """``# graftlint: allow(<rule-id>: <reason>)`` targeting
+        ``rule_id`` near ``lineno`` — the generic suppression every rule
+        honors."""
+        for ln in (lineno, lineno - 1, lineno + 1):
+            for act, reason in self.pragmas.get(ln, ()):
+                if act != "allow":
+                    continue
+                head, _, rest = reason.partition(":")
+                if head.strip() == rule_id:
+                    return rest.strip()
+        return None
+
+
+class RepoContext:
+    """Cross-file state handed to ``Rule.finish``: the repo root and the
+    README path for the docs-drift rule."""
+
+    def __init__(self, root: str, readme: Optional[str] = None):
+        self.root = root
+        self.readme = readme or os.path.join(root, "README.md")
+
+
+class Rule:
+    """One invariant. Subclasses set ``id``/``hint``, implement ``visit``
+    (called for every AST node with the walker's lexical context) and/or
+    the ``finish_file``/``finish`` hooks, and emit via ``self.emit``.
+
+    Rules never filter pragmas or baselines themselves (except the
+    exception-audit's ``swallow`` spelling, which is COMPLIANCE, not
+    suppression) — the harness applies ``allow`` pragmas and the baseline
+    uniformly after collection."""
+
+    id: str = ""
+    hint: str = ""
+
+    def __init__(self) -> None:
+        self.findings: List[Finding] = []
+
+    # -- hooks ---------------------------------------------------------------
+
+    def start_file(self, ctx: FileContext) -> None:
+        pass
+
+    def visit(self, node: ast.AST, walker: "Walker") -> None:
+        pass
+
+    def finish_file(self, ctx: FileContext) -> None:
+        pass
+
+    def finish(self, repo: RepoContext) -> None:
+        pass
+
+    # -- emission ------------------------------------------------------------
+
+    def emit(
+        self,
+        ctx: FileContext,
+        lineno: int,
+        message: str,
+        detail: str = "",
+        hint: Optional[str] = None,
+    ) -> None:
+        self.findings.append(
+            Finding(
+                rule=self.id,
+                path=ctx.rel,
+                line=lineno,
+                message=message,
+                hint=self.hint if hint is None else hint,
+                detail=detail,
+            )
+        )
+
+
+class Walker:
+    """One recursive pass over a file's AST, tracking the lexical context
+    rules need: enclosing class/function stacks and the with-held lock
+    stack. Rules read ``walker.class_stack``/``func_stack``/
+    ``lock_stack``/``ctx`` during ``visit``."""
+
+    #: with-items recognized as lock acquisitions: ``self.<x>`` or a bare
+    #: name whose identifier contains "lock" (``_lock``, ``_ds_lock``,
+    #: module-global ``_lock``).
+    @staticmethod
+    def lock_ident(expr: ast.AST) -> Optional[Tuple[str, str]]:
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and "lock" in expr.attr.lower()
+        ):
+            return ("self", expr.attr)
+        if isinstance(expr, ast.Name) and "lock" in expr.id.lower():
+            return ("global", expr.id)
+        return None
+
+    def __init__(self, ctx: FileContext, rules: List[Rule]):
+        self.ctx = ctx
+        self.rules = rules
+        self.class_stack: List[ast.ClassDef] = []
+        self.func_stack: List[ast.AST] = []  # FunctionDef/AsyncFunctionDef/Lambda
+        self.lock_stack: List[Tuple[str, str]] = []
+
+    @property
+    def qualname(self) -> str:
+        parts = [c.name for c in self.class_stack] + [
+            getattr(f, "name", "<lambda>") for f in self.func_stack
+        ]
+        return ".".join(parts) or "<module>"
+
+    def holds(self, ident: Tuple[str, str]) -> bool:
+        return ident in self.lock_stack
+
+    def walk(self, node: ast.AST) -> None:
+        for rule in self.rules:
+            rule.visit(node, self)
+        if isinstance(node, ast.ClassDef):
+            self.class_stack.append(node)
+            # a nested class's methods are not the outer function's body
+            saved_funcs, self.func_stack = self.func_stack, []
+            saved_locks, self.lock_stack = self.lock_stack, []
+            for child in ast.iter_child_nodes(node):
+                self.walk(child)
+            self.func_stack = saved_funcs
+            self.lock_stack = saved_locks
+            self.class_stack.pop()
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            self.func_stack.append(node)
+            for child in ast.iter_child_nodes(node):
+                self.walk(child)
+            self.func_stack.pop()
+        elif isinstance(node, ast.With):
+            acquired: List[Tuple[str, str]] = []
+            for item in node.items:
+                ident = self.lock_ident(item.context_expr)
+                if ident is not None:
+                    acquired.append(ident)
+                    self.lock_stack.append(ident)
+                self.walk(item.context_expr)
+                if item.optional_vars is not None:
+                    self.walk(item.optional_vars)
+            for child in node.body:
+                self.walk(child)
+            for _ in acquired:
+                self.lock_stack.pop()
+        else:
+            for child in ast.iter_child_nodes(node):
+                self.walk(child)
+
+
+def walk_file(ctx: FileContext, rules: List[Rule]) -> None:
+    for rule in rules:
+        rule.start_file(ctx)
+    Walker(ctx, rules).walk(ctx.tree)
+    for rule in rules:
+        rule.finish_file(ctx)
+
+
+def iter_python_files(paths: Iterable[str], root: str) -> List[Tuple[str, str]]:
+    """(abs_path, rel_path) for every .py under ``paths`` (files or dirs),
+    sorted, __pycache__ skipped. Raises FileNotFoundError for a missing
+    path — an unreadable target is exit 2, not an empty clean run."""
+    out: List[Tuple[str, str]] = []
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap):
+            out.append((ap, os.path.relpath(ap, root)))
+        elif os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d != "__pycache__"
+                )
+                for f in sorted(filenames):
+                    if f.endswith(".py"):
+                        fp = os.path.join(dirpath, f)
+                        out.append((fp, os.path.relpath(fp, root)))
+        else:
+            raise FileNotFoundError(f"no such file or directory: {p}")
+    return sorted(set(out))
+
+
+def lint_paths(
+    paths: Iterable[str],
+    rules: List[Rule],
+    root: str,
+    repo: Optional[RepoContext] = None,
+) -> Tuple[List[Finding], List[str]]:
+    """Run ``rules`` over every Python file under ``paths``. Returns
+    (findings after pragma suppression, unreadable-file errors). The
+    baseline is NOT applied here — callers own that policy (the CLI and
+    the doctor apply it; tests often want the raw findings)."""
+    repo = repo or RepoContext(root)
+    errors: List[str] = []
+    contexts: List[FileContext] = []
+    for ap, rel in iter_python_files(paths, root):
+        try:
+            with open(ap, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            ctx = FileContext(ap, rel, source)
+        except (OSError, SyntaxError, ValueError) as e:
+            errors.append(f"{rel}: {e}")
+            continue
+        contexts.append(ctx)
+        walk_file(ctx, rules)
+    for rule in rules:
+        rule.finish(repo)
+    ctx_by_rel = {c.rel: c for c in contexts}
+    findings: List[Finding] = []
+    for rule in rules:
+        for f in rule.findings:
+            ctx = ctx_by_rel.get(f.path)
+            if ctx is not None:
+                reason = ctx.allow_pragma(f.line, f.rule)
+                if reason:
+                    continue
+                if reason == "":  # pragma present but reasonless
+                    f = dataclasses.replace(
+                        f,
+                        message=f.message
+                        + " [allow pragma present but gives no reason]",
+                    )
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, errors
+
+
+# -- baseline ----------------------------------------------------------------
+
+
+def load_baseline(path: str) -> Counter:
+    """The baseline as a multiset of finding keys. Lines: ``#`` comments
+    (the mandatory justifications) and blanks are skipped; anything else
+    is one key, verbatim."""
+    keys: Counter = Counter()
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.rstrip("\n")
+            if not line.strip() or line.lstrip().startswith("#"):
+                continue
+            keys[line] += 1
+    return keys
+
+
+def apply_baseline(
+    findings: List[Finding], baseline: Counter
+) -> Tuple[List[Finding], List[str]]:
+    """(new findings not covered by the baseline, stale baseline keys with
+    no live finding). Multiset semantics: N identical findings need N
+    baseline entries."""
+    remaining = Counter(baseline)
+    new: List[Finding] = []
+    for f in findings:
+        if remaining.get(f.key, 0) > 0:
+            remaining[f.key] -= 1
+        else:
+            new.append(f)
+    stale = sorted(k for k, n in remaining.items() if n > 0 for _ in range(n))
+    return new, stale

@@ -1,0 +1,388 @@
+"""The HLO collective contracts as DATA: one declarative manifest of
+jitted entrypoint → required/forbidden collectives, checked by one driver.
+
+The model-parallel layer's contracts are comms contracts — "the pipeline
+feed ring moves microbatches by collective-permute and never gathers the
+stream", "EP MoE dispatch is an all-to-all" (the GSPMD sharding
+discipline, PAPERS.md) — and before this manifest each pin lived as an
+inline ``contains=/absent=`` pair duplicated across four test files. Here
+the contract lives ONCE: tests and the ``python -m tools.graftlint
+--hlo`` driver both read this table, so a new schedule variant gets its
+pin by adding a row, and the diagnostics-on/off twins can't drift from
+each other.
+
+Builders construct the exact (fn, args) the historical tests compiled
+(same meshes, shapes, and sharding layouts), and the driver compiles
+through ``tests/hlo_util.compiled()`` — the one compiled-handle owner —
+so the text being grepped is the post-SPMD-partitioning program the
+backend will actually run. Everything jax-flavored imports lazily: the
+static lint rules never pay for (or require) a backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "HloContract",
+    "CONTRACTS",
+    "get",
+    "build",
+    "verify",
+    "check_contracts",
+    "ensure_hlo_util",
+]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@dataclasses.dataclass(frozen=True)
+class HloContract:
+    """One jitted entrypoint's collective contract.
+
+    ``contains``: collectives that MUST appear in the compiled HLO;
+    ``absent``: collectives that must NOT. ``builder`` returns (fn, args)
+    ready to compile — the canonical construction of the entrypoint at
+    pin scale (8-device CPU mesh)."""
+
+    name: str
+    entrypoint: str  # dotted, human-facing: which jitted fn this pins
+    contains: Tuple[str, ...]
+    absent: Tuple[str, ...]
+    builder: Callable[[], Tuple[Callable, Tuple]]
+    diagnostics: bool = False
+    note: str = ""
+
+
+def ensure_hlo_util():
+    """Import tests/hlo_util (the one compiled-handle owner) from the
+    repo's tests directory, forcing the 8-device CPU platform first when
+    no backend exists yet (the tests' conftest does the same)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    tests_dir = os.path.join(_REPO_ROOT, "tests")
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+    import hlo_util
+
+    return hlo_util
+
+
+# ---------------------------------------------------------------------------
+# builders (lazy jax imports; constructions mirror the historical pins)
+# ---------------------------------------------------------------------------
+
+
+def _pipeline_fixture(n_stages: int, d: int = 8, seed: int = 0):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    params = {
+        "w": jnp.asarray(rng.normal(size=(n_stages, d, d)) * 0.5, jnp.float32),
+        "b": jnp.asarray(rng.normal(size=(n_stages, d)) * 0.1, jnp.float32),
+    }
+
+    def stage_fn(p, x):
+        return jax.nn.gelu(x @ p["w"] + p["b"])
+
+    return params, stage_fn
+
+
+def _build_pipeline_feed_ring():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_tfrecord.models import pipeline
+    from tpu_tfrecord.tpu import create_mesh
+
+    mesh = create_mesh({"pipe": 4}, jax.devices()[:4])
+    params, stage_fn = _pipeline_fixture(4)
+    xs = jnp.zeros((4, 2, 8), jnp.float32)
+    p_sh = jax.device_put(params, NamedSharding(mesh, P("pipe")))
+    xs_sh = jax.device_put(
+        xs, pipeline.microbatch_sharding(mesh, "pipe", ndim=xs.ndim)
+    )
+    fn = jax.jit(lambda p, x: pipeline.pipeline_apply(stage_fn, p, x, mesh))
+    return fn, (p_sh, xs_sh)
+
+
+def _build_pipeline_feed_ring_dp():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_tfrecord.models import pipeline
+    from tpu_tfrecord.tpu import create_mesh
+
+    mesh = create_mesh({"pipe": 4, "data": 2})
+    params, stage_fn = _pipeline_fixture(4)
+    xs = jnp.zeros((8, 4, 8), jnp.float32)
+    p_sh = jax.device_put(params, NamedSharding(mesh, P("pipe")))
+    xs_sh = jax.device_put(
+        xs,
+        pipeline.microbatch_sharding(mesh, ndim=xs.ndim, batch_spec=P("data")),
+    )
+    fn = jax.jit(
+        lambda p, x: pipeline.pipeline_apply(
+            stage_fn, p, x, mesh, batch_spec=P("data")
+        )
+    )
+    return fn, (p_sh, xs_sh)
+
+
+def _build_pipeline_diagnostics():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_tfrecord.models import pipeline
+    from tpu_tfrecord.tpu import create_mesh
+
+    mesh = create_mesh({"pipe": 4, "data": 2})
+    params = {
+        "w": jnp.asarray(
+            np.random.default_rng(0).normal(size=(4, 8, 8)) * 0.1, jnp.float32
+        )
+    }
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    xs = jnp.zeros((8, 4, 8), jnp.float32)
+    xs_sh = jax.device_put(xs, pipeline.microbatch_sharding(mesh, ndim=3))
+    fn = jax.jit(
+        lambda p, x: pipeline.pipeline_apply(
+            stage_fn, p, x, mesh, diagnostics=True
+        )[0]
+    )
+    return fn, (params, xs_sh)
+
+
+def _moe_fixture(cfg):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_tfrecord.models import moe
+
+    params = moe.init_params(jax.random.key(0), cfg)
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(2, 16, cfg.d_model)),
+        jnp.float32,
+    )
+    return params, x
+
+
+def _build_moe_apply_ep():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_tfrecord.models import moe
+    from tpu_tfrecord.tpu import create_mesh
+
+    mesh = create_mesh({"expert": 4}, jax.devices()[:4])
+    cfg = moe.MoEConfig(d_model=16, d_ff=32, n_experts=4, top_k=2)
+    params, x = _moe_fixture(cfg)
+    sh = moe.param_shardings(mesh, expert_axis="expert")
+    p_sh = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+    x_sh = jax.device_put(x, NamedSharding(mesh, P(None, "expert", None)))
+    fn = jax.jit(lambda p, x: moe.moe_apply_ep(p, x, cfg, mesh))
+    return fn, (p_sh, x_sh)
+
+
+def _build_moe_apply_ep_diagnostics():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_tfrecord.models import moe
+    from tpu_tfrecord.tpu import create_mesh
+
+    cfg = moe.MoEConfig(d_model=8, d_ff=16, n_experts=4, top_k=2, capacity_factor=1.0)
+    params = moe.init_params(jax.random.key(0), cfg)
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(16, 8)), jnp.float32
+    )
+    mesh = create_mesh({"expert": 4, "data": 2})
+    fn = jax.jit(
+        lambda p, x: moe.moe_apply_ep(p, x, cfg, mesh, diagnostics=True)
+    )
+    return fn, (params, x)
+
+
+def _build_lm_train_step():
+    import functools
+
+    import jax
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_tfrecord.models import lm
+    from tpu_tfrecord.tpu import create_mesh
+
+    cfg = lm.LMConfig(
+        vocab_size=64, d_model=16, n_heads=2, n_layers=4, max_len=16,
+        n_micro=4,
+    )
+    mesh = create_mesh({"pipe": 4, "data": 2})
+    params = lm.init_params(jax.random.key(0), cfg)
+    p_sh = jax.device_put(
+        params, lm.param_shardings(mesh, params, pipe_axis="pipe")
+    )
+    tx = optax.sgd(1e-2)
+    opt = jax.device_put(
+        tx.init(params),
+        jax.tree.map(lambda _: NamedSharding(mesh, P()), tx.init(params)),
+    )
+    toks = jax.numpy.asarray(lm.make_synthetic_tokens(cfg, 8, seed=0))
+    step = jax.jit(
+        functools.partial(
+            lm.train_step, cfg=cfg, tx=tx, mesh=mesh, data_axis="data",
+            pipe_axis="pipe",
+        )
+    )
+    return step, (p_sh, opt, toks)
+
+
+#: The manifest. Every historical inline pin appears here exactly once;
+#: the diagnostics rows pin that the flag adds no forbidden collective
+#: (its off twin is the same entrypoint's plain row).
+CONTRACTS: Dict[str, HloContract] = {
+    c.name: c
+    for c in (
+        HloContract(
+            name="pipeline_feed_ring",
+            entrypoint="models.pipeline.pipeline_apply",
+            contains=("collective-permute",),
+            absent=("all-gather", "all-reduce", "all-to-all"),
+            builder=_build_pipeline_feed_ring,
+            note="feed/activation/output movement is neighbor permutes of "
+            "ONE microbatch slice; the old full-stream psum broadcast "
+            "is banned outright",
+        ),
+        HloContract(
+            name="pipeline_feed_ring_dp",
+            entrypoint="models.pipeline.pipeline_apply (dp x pp)",
+            contains=("collective-permute",),
+            absent=("all-gather",),
+            builder=_build_pipeline_feed_ring_dp,
+            note="composing a data axis must not re-introduce a gather of "
+            "the stream (all-reduce is dp's legitimate collective here)",
+        ),
+        HloContract(
+            name="pipeline_diagnostics",
+            entrypoint="models.pipeline.pipeline_apply(diagnostics=True)",
+            contains=("collective-permute",),
+            absent=("all-gather",),
+            builder=_build_pipeline_diagnostics,
+            diagnostics=True,
+            note="the bubble counter threads the schedule's own loop — "
+            "identical per device, so no collective may be added",
+        ),
+        HloContract(
+            name="moe_apply_ep",
+            entrypoint="models.moe.moe_apply_ep",
+            contains=("all-to-all",),
+            absent=("all-gather",),
+            builder=_build_moe_apply_ep,
+            note="EP dispatch is an all-to-all; neither tokens nor expert "
+            "weights are ever gathered",
+        ),
+        HloContract(
+            name="moe_apply_ep_diagnostics",
+            entrypoint="models.moe.moe_apply_ep(diagnostics=True)",
+            contains=("all-to-all",),
+            absent=("all-gather",),
+            builder=_build_moe_apply_ep_diagnostics,
+            diagnostics=True,
+            note="diagnostics add [E]-sized psums, never a token gather",
+        ),
+        HloContract(
+            name="lm_train_step",
+            entrypoint="models.lm.train_step (dp x pp)",
+            contains=("collective-permute",),
+            absent=("all-gather",),
+            builder=_build_lm_train_step,
+            note="the acceptance pin at the train-step level; grads over "
+            "'data' still all-reduce — dp's collective, not the pipeline's",
+        ),
+    )
+}
+
+
+def get(name: str) -> HloContract:
+    try:
+        return CONTRACTS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown HLO contract {name!r}; known: {sorted(CONTRACTS)}"
+        ) from None
+
+
+def build(contract: HloContract) -> Tuple[Callable, Tuple]:
+    return contract.builder()
+
+
+def verify(name_or_contract, fn=None, args=None) -> str:
+    """Compile one contract's entrypoint and assert its collective pins;
+    returns the HLO text. Tests pass their OWN (fn, args) when they pin a
+    construction they already built — the contract (contains/absent)
+    still lives here; with fn omitted the manifest builder is used."""
+    c = (
+        name_or_contract
+        if isinstance(name_or_contract, HloContract)
+        else get(name_or_contract)
+    )
+    hlo_util = ensure_hlo_util()
+    if fn is None:
+        fn, args = build(c)
+    hlo = hlo_util.compiled(fn, *args).as_text()
+    for op in c.contains:
+        assert op in hlo, (
+            f"HLO contract {c.name}: expected {op!r} in compiled HLO of "
+            f"{c.entrypoint}, not found"
+        )
+    for op in c.absent:
+        assert op not in hlo, (
+            f"HLO contract {c.name}: forbidden {op!r} present in compiled "
+            f"HLO of {c.entrypoint}"
+        )
+    return hlo
+
+
+def check_contracts(
+    names: Optional[Iterable[str]] = None,
+) -> List[Dict]:
+    """The ``--hlo`` driver: build + compile + check every manifest row.
+    Returns one dict per contract: {name, entrypoint, ok, error, skipped}.
+    A missing optional dep (optax for the LM row) reports skipped, not
+    failed — the static gate must run on codec-only installs."""
+    results: List[Dict] = []
+    for name in names if names is not None else sorted(CONTRACTS):
+        c = get(name)
+        entry = {
+            "name": c.name, "entrypoint": c.entrypoint, "ok": False,
+            "error": None, "skipped": False,
+        }
+        try:
+            verify(c)
+            entry["ok"] = True
+        except ImportError as e:
+            entry["skipped"] = True
+            entry["error"] = f"optional dependency missing: {e}"
+        except AssertionError as e:
+            entry["error"] = str(e)
+        except Exception as e:  # build/compile failure is a finding too  # graftlint: swallow(failure captured into the result row the driver reports)
+            entry["error"] = f"{type(e).__name__}: {e}"
+        results.append(entry)
+    return results

@@ -345,7 +345,7 @@ def report_main(argv: List[str]) -> int:
                 batches += 1
                 if batches >= args.batches:
                     break
-    except Exception as e:  # unreadable dataset, not a slow one
+    except Exception as e:  # unreadable dataset, not a slow one  # graftlint: swallow(error event emitted + exit 2)
         emit({"event": "error", "path": args.data_dir, "error": str(e)})
         return 2
     finally:
@@ -486,7 +486,7 @@ def tune_main(argv: List[str]) -> int:
                 if time.perf_counter() >= deadline:
                     break
             elapsed = time.perf_counter() - t0
-    except Exception as e:  # unreadable dataset, not a slow one
+    except Exception as e:  # unreadable dataset, not a slow one  # graftlint: swallow(error event emitted + exit 2)
         emit({"event": "error", "path": args.data_dir, "error": str(e)})
         return 2
     for decision in tuner.log:
@@ -580,7 +580,7 @@ def _fleet_report(args, emit) -> int:
             trace_id=args.trace_id,
         )
         snap = agg.aggregate()
-    except Exception as e:
+    except Exception as e:  # graftlint: swallow(error event emitted + exit 2)
         # unreadable dir, or spool contents the aggregator cannot merge —
         # either way the documented contract is an error line + exit 2,
         # never a traceback
@@ -647,7 +647,7 @@ def _fleet_report(args, emit) -> int:
         }
         try:
             q = fleet.quantiles_ms_from_states(p.hists)
-        except Exception:
+        except Exception:  # graftlint: swallow(one corrupt hist state drops its quantiles, keeps the report)
             q = None  # one process's corrupt hist state: drop its
             # quantiles, keep its line (and the rest of the report)
         if q:
@@ -851,7 +851,7 @@ def _train_report(args, emit) -> int:
         # reusing its classification keeps `doctor train` and
         # `doctor fleet` agreeing about the same spool file
         snap = agg.aggregate()
-    except Exception as e:
+    except Exception as e:  # graftlint: swallow(error event emitted + exit 2)
         emit({"event": "error", "path": args.spool_dir, "error": str(e)})
         return 2
     procs = snap.processes
@@ -994,6 +994,84 @@ def merge_trace_main(argv: List[str]) -> int:
     return 0
 
 
+def lint_main(argv: List[str]) -> int:
+    """The ``lint`` subcommand: run the graftlint invariant suite
+    (tools/graftlint — clock/atomic-write/lock/except/vocabulary rules,
+    plus the HLO collective contracts under ``--hlo``) doctor-shaped: one
+    ``finding`` event per non-baselined violation, ``stale_baseline``
+    warnings, ``hlo_contract`` rows, and a final ``lint`` summary. Exit
+    0 = clean; 1 = findings (or a failed HLO contract); 2 = an input
+    could not be read/parsed."""
+    ap = argparse.ArgumentParser(
+        prog="tfrecord_doctor lint",
+        description="Run the repo's AST + HLO invariant checker "
+        "(tools/graftlint)",
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="files/dirs to lint (default: tpu_tfrecord tools examples)",
+    )
+    ap.add_argument(
+        "--baseline", default=None, metavar="PATH",
+        help="baseline of grandfathered finding keys "
+        "(default: tools/graftlint/baseline.txt)",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, including baselined ones",
+    )
+    ap.add_argument(
+        "--hlo", action="store_true",
+        help="also compile and check the HLO collective contracts (slow)",
+    )
+    _add_json_flag(ap)
+    args = ap.parse_args(argv)
+
+    emit = _Emitter(args.json)
+    try:
+        return _lint_report(args, emit)
+    finally:
+        emit.close()
+
+
+def _lint_report(args, emit) -> int:
+    from tools.graftlint import DEFAULT_BASELINE, run_lint
+
+    baseline = None if args.no_baseline else (args.baseline or DEFAULT_BASELINE)
+    try:
+        result = run_lint(
+            paths=args.paths or None, baseline=baseline, hlo=args.hlo
+        )
+    except FileNotFoundError as e:
+        emit({"event": "error", "error": str(e)})
+        return 2
+    for f in result["findings"]:
+        emit(f.to_json())
+    for key in result["stale_baseline"]:
+        emit({"event": "stale_baseline", "key": key})
+    for err in result["errors"]:
+        emit({"event": "error", "error": err})
+    for entry in result["hlo"]:
+        emit({"event": "hlo_contract", **entry})
+    hlo_failed = [
+        e for e in result["hlo"] if not e["ok"] and not e["skipped"]
+    ]
+    emit(
+        {
+            "event": "lint",
+            "findings": len(result["findings"]),
+            "baselined": result["baselined"],
+            "stale_baseline": len(result["stale_baseline"]),
+            "errors": len(result["errors"]),
+            "hlo_checked": len(result["hlo"]),
+            "hlo_failed": len(hlo_failed),
+        }
+    )
+    if result["errors"]:
+        return 2
+    return 1 if (result["findings"] or hlo_failed) else 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -1011,6 +1089,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return serve_status_main(argv[1:])
     if argv and argv[0] == "merge-trace":
         return merge_trace_main(argv[1:])
+    if argv and argv[0] == "lint":
+        return lint_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="tfrecord_doctor", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
@@ -1078,7 +1158,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     summary = doctor_file(
                         path, args.repair, args.out, args.max_record_bytes, emit
                     )
-                except Exception as e:  # unreadable file, not corrupt frames
+                except Exception as e:  # unreadable file, not corrupt frames  # graftlint: swallow(error event emitted per file; rc=2)
                     emit({"event": "error", "path": path, "error": str(e)})
                     rc = 2
                     continue

@@ -8,6 +8,15 @@ cd "$(dirname "$0")/.."
 echo "== syntax gate (compileall) =="
 python -m compileall -q tpu_tfrecord || exit 1
 
+echo "== graftlint gate (AST invariants vs the committed baseline) =="
+# Zero non-baselined findings over tpu_tfrecord/ tools/ examples/: clock
+# discipline in policy modules, atomic persisted writes, the Metrics lock
+# contract + lock-order graph, exception-swallow audit, and the metric
+# vocabulary (call sites AND the README block). The HLO collective
+# contracts (tools/graftlint/hlo_contracts.py) are compiled by the
+# migrated pins inside the tier-1 run below.
+python -m tools.graftlint || exit 1
+
 echo "== tfrecord_doctor self-check =="
 # Write a shard, flip one byte, assert the doctor reports exactly one bad
 # frame and that --repair round-trips every other record — so the salvage

@@ -213,7 +213,7 @@ def load() -> Optional[ctypes.CDLL]:
             ):
                 _build()
             _lib = _bind(ctypes.CDLL(_LIB_PATH))
-        except Exception as e:  # pragma: no cover - depends on toolchain
+        except Exception as e:  # pragma: no cover - depends on toolchain  # graftlint: swallow(toolchain-dependent build: _load_error recorded, python fallback serves)
             _load_error = str(e)
             _lib = None
         return _lib
@@ -837,7 +837,7 @@ class InferScanner:
     def __del__(self):  # last-resort cleanup; close() is the contract
         try:
             self.close()
-        except Exception:
+        except Exception:  # graftlint: swallow(interpreter-teardown destructor; nowhere to report)
             pass
 
 

@@ -189,7 +189,7 @@ def _section_crc(arr: np.ndarray) -> int:
                     arr.ctypes.data_as(ctypes.c_char_p), arr.nbytes
                 )
             )
-    except Exception:  # noqa: BLE001 — fall back to the bytes path
+    except Exception:  # noqa: BLE001 — fall back to the bytes path  # graftlint: swallow(native CRC unavailable: bytes-path CRC below returns the same value)
         pass
     return wire.crc32c(arr.tobytes())
 
@@ -517,6 +517,10 @@ class CachePopulator:
 
     def _kill(self, why: str) -> None:
         self._dead = True
+        # the swallowed append/commit failures land here: one counter per
+        # aborted populate job, so "caching never fails an epoch" stays
+        # observable on the pulse/doctor instead of silently serving cold
+        METRICS.count("cache.populate_errors")
         logger.warning(
             "tfrecord.cache populate of %s disabled: %s", self.final_path, why
         )
@@ -565,7 +569,7 @@ class CachePopulator:
                 }
             )
             self._rows += batch.num_rows
-        except Exception as e:  # noqa: BLE001 — caching never fails an epoch
+        except Exception as e:  # noqa: BLE001 — caching never fails an epoch  # graftlint: swallow(counted in _kill (cache.populate_errors); caching never fails an epoch)
             self._kill(f"append failed: {e}")
 
     def commit(self) -> bool:
@@ -604,7 +608,7 @@ class CachePopulator:
             _fs.filesystem_for(self._cache.cache_dir).rename(
                 self._tmp_path, self.final_path
             )
-        except Exception as e:  # noqa: BLE001 — caching never fails an epoch
+        except Exception as e:  # noqa: BLE001 — caching never fails an epoch  # graftlint: swallow(counted in _kill (cache.populate_errors); caching never fails an epoch)
             self._kill(f"commit failed: {e}")
             return False
         METRICS.count("cache.bytes_written", self._pos)
@@ -896,7 +900,7 @@ class ShardCache:
                     and _source_matches(entry.footer, source)
                 )
             footer = load_footer(path)
-        except Exception:  # noqa: BLE001 — unreadable/corrupt = not cached
+        except Exception:  # noqa: BLE001 — unreadable/corrupt = not cached  # graftlint: swallow(side-effect-free probe: unreadable reads as not-cached)
             return False
         return (
             footer.get("fingerprint") == self.fingerprint
@@ -1029,7 +1033,7 @@ def inspect_entry(path: str) -> Dict[str, Any]:
                 return report
             if not _source_matches(footer, source_stat(src_path)):
                 report["status"] = "stale"
-        except Exception:  # noqa: BLE001 — store unavailable, not stale
+        except Exception:  # noqa: BLE001 — store unavailable, not stale  # graftlint: swallow(doctor report discloses source_check=unavailable)
             report["source_check"] = "unavailable"
         return report
     if src_path:

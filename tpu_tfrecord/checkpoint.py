@@ -32,7 +32,7 @@ def state_path(directory: str, process_index: Optional[int] = None) -> str:
             import jax
 
             process_index = jax.process_index()
-        except Exception:
+        except Exception:  # graftlint: swallow(no distributed runtime: process 0)
             process_index = 0
     # "_"-prefixed like _SUCCESS: shard discovery treats it as metadata, so a
     # state file inside a dataset directory can never be read as a shard.

@@ -392,7 +392,7 @@ class SubprocessSpawner:
             if p.poll() is None:
                 try:
                     p.wait(timeout=timeout)
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001  # graftlint: swallow(best-effort shutdown reap; kill() fallback follows)
                     try:
                         p.kill()
                     except OSError:

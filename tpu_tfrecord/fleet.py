@@ -255,7 +255,7 @@ class TelemetrySpool:
             except Exception:
                 try:
                     self.metrics.count("fleet.spool_errors")
-                except Exception:
+                except Exception:  # graftlint: swallow(the spool_errors counter itself failed; spooling never raises)
                     pass
 
     # -- lifecycle -----------------------------------------------------------

@@ -174,7 +174,7 @@ class _HttpFile:
         if self._conn is not None:
             try:
                 self._conn.close()
-            except Exception:
+            except Exception:  # graftlint: swallow(closing a poisoned keep-alive connection)
                 pass
             self._conn = None
 
@@ -210,7 +210,7 @@ class _HttpFile:
                 loc = resp.headers.get("Location")
                 try:
                     resp.read()
-                except Exception:
+                except Exception:  # graftlint: swallow(malformed Location: loud OSError raised just below)
                     pass
                 if not loc:
                     self._drop_response()
@@ -262,7 +262,7 @@ class _HttpFile:
                 retry_after = _parse_retry_after(resp.headers.get("Retry-After"))
                 try:
                     resp.read()
-                except Exception:
+                except Exception:  # graftlint: swallow(unparseable Retry-After: HTTPStatusError raised without it)
                     pass
                 self._drop_response()
                 raise HTTPStatusError(

@@ -1120,7 +1120,7 @@ def _producer_loop(
         if avail and not ds.drop_remainder:
             emit_from(pending, avail)
         _put_until_stopped(out_queue, None, stop)
-    except BaseException as e:  # propagate to consumer
+    except BaseException as e:  # propagate to consumer  # graftlint: swallow(exception forwarded to the consumer queue and re-raised there)
         _put_until_stopped(out_queue, e, stop)
 
 
@@ -1232,7 +1232,7 @@ def _shuffled_producer_loop(
         if rows and not flush(stream_end, tail=True):
             return
         _put_until_stopped(out_queue, None, stop)
-    except BaseException as e:  # propagate to consumer
+    except BaseException as e:  # propagate to consumer  # graftlint: swallow(exception forwarded to the consumer queue and re-raised there)
         _put_until_stopped(out_queue, e, stop)
 
 
@@ -1377,7 +1377,7 @@ def _parallel_chunks(
                         # full queue — a DONE shard backpressured behind the
                         # emitter must never look wedged
                         put_checked(job.out, ("end", None), job=job)
-                    except BaseException as e:
+                    except BaseException as e:  # graftlint: swallow(failure encoded into the job result for the emitter)
                         if job.wedged:
                             replaced = True
                             return
@@ -1664,12 +1664,12 @@ class CheckpointableIterator:
         if pulse is not None:
             try:
                 pulse.stop()
-            except Exception:
+            except Exception:  # graftlint: swallow(telemetry teardown must not fail iterator close)
                 pass
         if self._spool_dir is not None:
             try:
                 self._spool_finalizer()  # once-only: safe vs the GC path
-            except Exception:
+            except Exception:  # graftlint: swallow(telemetry teardown must not fail iterator close)
                 pass
 
     def state(self) -> IteratorState:

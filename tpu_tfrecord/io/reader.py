@@ -186,7 +186,7 @@ def salvage_spans_stream(
                                 "error": str(e),
                             }
                         )
-                    except BaseException as esc:
+                    except BaseException as esc:  # graftlint: swallow(escalated after salvage accounting (escalate re-raised))
                         escalate = esc
                     data = b""
                     eof = True  # the decompressor lost sync: stream over
@@ -215,7 +215,7 @@ def salvage_spans_stream(
                                 "bytes_skipped": file_off + r - bad_at,
                             }
                         )
-                    except BaseException as esc:
+                    except BaseException as esc:  # graftlint: swallow(escalated after salvage accounting (escalate re-raised))
                         escalate = esc
                         break
                     bad_at = None
@@ -733,7 +733,7 @@ class DatasetReader:
         exc: Optional[BaseException] = None
         try:
             local = self.local_type_map(mine, num_workers=num_workers)
-        except Exception as e:  # noqa: BLE001 — encoded into the collective
+        except Exception as e:  # noqa: BLE001 — encoded into the collective  # graftlint: swallow(error encoded into the allgather, re-raised on every host)
             err = f"{type(e).__name__}: {e}"
             exc = e
         try:

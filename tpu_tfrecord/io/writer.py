@@ -254,7 +254,7 @@ class DatasetWriter:
             for w in writers.values():
                 try:
                     w.close()
-                except Exception:
+                except Exception:  # graftlint: swallow(close hygiene on the abort path; original error re-raised below)
                     pass
             job.abort()
             raise
@@ -378,7 +378,7 @@ def sweep_orphan_jobs(
                 if not (local_dead or lease_stale):
                     continue
                 why = "dead pid" if local_dead else "stale lease"
-            except Exception:
+            except Exception:  # graftlint: swallow(no/unreadable marker: cannot judge ownership, leave the dir)
                 continue  # no/unreadable marker: can't judge, leave it
             try:
                 fs.rmtree(job_dir, ignore_errors=True)
@@ -387,9 +387,9 @@ def sweep_orphan_jobs(
                     "tfrecord.write swept orphaned staging dir %s "
                     "(crashed job, pid %s, %s)", job_dir, pid, why,
                 )
-            except Exception:
+            except Exception:  # graftlint: swallow(best-effort orphan staging sweep)
                 pass
-    except Exception:
+    except Exception:  # graftlint: swallow(best-effort orphan staging sweep)
         pass
     return removed
 
@@ -763,7 +763,7 @@ class _SlabPipeline:
             if stream.sink is not None:
                 try:
                     stream.sink.close()
-                except Exception:
+                except Exception:  # graftlint: swallow(abort hygiene: partial slabs already being discarded)
                     pass
                 stream.sink = None
 
@@ -1072,7 +1072,7 @@ def _write_batches(
         for w in writers.values():
             try:
                 w.close()
-            except Exception:
+            except Exception:  # graftlint: swallow(close hygiene on the abort path; original error re-raised below)
                 pass
         job.abort()
         raise

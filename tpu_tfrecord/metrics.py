@@ -19,6 +19,13 @@ Three value kinds live in one registry (distinct storage, one lock):
   p50/p90/p99 sit next to the totals and stragglers stop hiding inside
   means. ``timed`` feeds them automatically — one observation per timed
   block, same lock acquisition as the totals update.
+
+Every name passed to these calls must be registered in
+``tpu_tfrecord.vocabulary`` (the single owner of the metric/span name
+vocabulary) and documented in the README's generated vocabulary block —
+``tools/graftlint`` enforces both directions, so a dashboard keyed on a
+documented name can never silently read zero because the code spells it
+differently.
 """
 
 from __future__ import annotations

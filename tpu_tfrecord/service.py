@@ -294,23 +294,33 @@ class ServiceDispatcher:
         except (OSError, ValueError) as e:
             raise RuntimeError(f"unreadable dispatcher journal {path}: {e}")
         now = self._clock()
-        for wid, info in dict(obj.get("workers", {})).items():
-            self._workers[str(wid)] = _WorkerInfo(
-                str(wid), str(info["addr"]), int(info.get("pid", 0)), now
-            )
-        self._leases = {str(k): str(v) for k, v in dict(obj.get("leases", {})).items()}
-        self._done = {str(k): str(v) for k, v in dict(obj.get("done", {})).items()}
-        self._reassignments = int(obj.get("reassignments", 0))
-        self._draining = {
-            str(w): now for w in obj.get("draining", []) if str(w) in self._workers
-        }
-        for t, info in dict(obj.get("tenants", {})).items():
-            self._tenants[str(t)] = {
-                "consumers": set(info.get("consumers", [])),
-                "jobs": set(info.get("jobs", [])),
-                "shared_cache_hits": int(info.get("shared_cache_hits", 0)),
-                "completions": int(info.get("completions", 0)),
+        # construction-time today, but the assignment books are the
+        # _lock-guarded state: hold the lock so a future caller (live
+        # re-replay, tests) gets the same contract as every other writer
+        with self._lock:
+            for wid, info in dict(obj.get("workers", {})).items():
+                self._workers[str(wid)] = _WorkerInfo(
+                    str(wid), str(info["addr"]), int(info.get("pid", 0)), now
+                )
+            self._leases = {
+                str(k): str(v) for k, v in dict(obj.get("leases", {})).items()
             }
+            self._done = {
+                str(k): str(v) for k, v in dict(obj.get("done", {})).items()
+            }
+            self._reassignments = int(obj.get("reassignments", 0))
+            self._draining = {
+                str(w): now
+                for w in obj.get("draining", [])
+                if str(w) in self._workers
+            }
+            for t, info in dict(obj.get("tenants", {})).items():
+                self._tenants[str(t)] = {
+                    "consumers": set(info.get("consumers", [])),
+                    "jobs": set(info.get("jobs", [])),
+                    "shared_cache_hits": int(info.get("shared_cache_hits", 0)),
+                    "completions": int(info.get("completions", 0)),
+                }
         trace = obj.get("trace")
         if isinstance(trace, dict):
             self._ctx = telemetry.adopt(
@@ -1037,7 +1047,7 @@ class DecodeWorker:
         def _build() -> None:
             try:
                 built["ds"] = self._dataset_for(spec)
-            except BaseException as e:
+            except BaseException as e:  # graftlint: swallow(error shipped to the consumer as a protocol error op)
                 built["err"] = e
             finally:
                 done.set()
@@ -1377,8 +1387,10 @@ def _run_forever(stop_event: threading.Event) -> None:
     except ValueError:
         pass  # not the main thread (tests drive main() directly)
     try:
-        while not stop_event.is_set():
-            time.sleep(0.2)
+        # the event IS the wait seam: no bare time.sleep in a policy
+        # module, and SIGTERM/stop wakes the loop immediately
+        while not stop_event.wait(0.2):
+            pass
     except KeyboardInterrupt:
         pass
 

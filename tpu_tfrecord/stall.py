@@ -91,7 +91,7 @@ class _OpWorker:
             fn, fut = item
             try:
                 result = fn()
-            except BaseException as e:  # delivered through the future
+            except BaseException as e:  # delivered through the future  # graftlint: swallow(delivered through the op future (set_exception))
                 fut.set_exception(e)
             else:
                 fut.set_result(result)
@@ -146,7 +146,7 @@ _SHARED_POOL = _WorkerPool()
 def _close_quietly(fh) -> None:
     try:
         fh.close()
-    except Exception:
+    except Exception:  # graftlint: swallow(closing an abandoned/stalled handle; nothing to deliver to)
         pass
 
 
@@ -290,7 +290,7 @@ class GuardedReadStream:
         if backup_fut in done:
             try:
                 bfh, data = backup_fut.result()
-            except BaseException:
+            except BaseException:  # graftlint: swallow(losing hedge leg abandoned; winner already returned)
                 # The BACKUP failed (its open/read erred) while the primary
                 # is merely slow: a failed hedge must not shorten the
                 # primary's deadline — keep waiting on the primary for the
@@ -382,7 +382,7 @@ class GuardedReadStream:
         except _FutureTimeout:
             worker.abandon()
             return
-        except Exception:
+        except Exception:  # graftlint: swallow(pool checkin of an abandoned worker at guard close)
             pass
         if self._pool is not None:
             self._pool.checkin(worker)

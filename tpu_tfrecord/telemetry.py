@@ -985,14 +985,14 @@ class Pulse:
         if final and not already:
             try:
                 self.tick()
-            except Exception:
+            except Exception:  # graftlint: swallow(final tail tick is best-effort at stop)
                 pass
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
             try:
                 self.tick()
-            except Exception:
+            except Exception:  # graftlint: swallow(telemetry must never take the pipeline down)
                 # telemetry must never take the pipeline down
                 pass
 
@@ -1055,7 +1055,7 @@ class Pulse:
                 # this very pulse's counters on the NEXT tick
                 try:
                     self.metrics.count("pulse.observer_errors")
-                except Exception:
+                except Exception:  # graftlint: swallow(the observer_errors counter itself failed)
                     pass
         self.emit(payload)
         return payload

@@ -48,7 +48,7 @@ def initialize(
         # client is created; harmless when already initialized.
         try:
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
-        except Exception:
+        except Exception:  # graftlint: swallow(older/newer jax without the knob: keep prior behavior)
             pass  # older/newer jax without the knob: keep prior behavior
     jax.distributed.initialize(
         coordinator_address=coordinator_address,

@@ -147,7 +147,7 @@ def hash_bytes_column(col_or_blobs, num_buckets: int) -> np.ndarray:
                 return _native.hash_blob(
                     col.blob, col.blob_offsets, num_buckets
                 ).astype(np.int32)
-        except Exception:
+        except Exception:  # graftlint: swallow(native hash unavailable: python path below is the oracle)
             pass
         blobs = col.blobs
     else:
@@ -498,7 +498,7 @@ class HostPrefetcher:
                     if self._stop.is_set():
                         return
                 self._queue.put(self._DONE)
-            except BaseException as e:  # noqa: BLE001 — repropagated in consumer
+            except BaseException as e:  # noqa: BLE001 — repropagated in consumer  # graftlint: swallow(exception forwarded to the consumer queue, repropagated)
                 self._queue.put(e)
 
         self._thread = threading.Thread(target=_produce, daemon=True)

@@ -34,7 +34,7 @@ def _profiler():
             import jax.profiler as prof
 
             _PROF = prof
-        except Exception:  # pragma: no cover - jax always present in this repo
+        except Exception:  # pragma: no cover - jax always present in this repo  # graftlint: swallow(no jax profiler available: tracing disabled)
             _PROF = None
     return _PROF
 

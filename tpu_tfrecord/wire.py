@@ -94,7 +94,7 @@ def _crc32c_bootstrap(data: bytes) -> int:
 
         if _native.available():
             impl = _native.crc32c
-    except Exception:
+    except Exception:  # graftlint: swallow(crc32c bootstrap: fall through to the next implementation)
         pass
     crc32c = impl
     return impl(data)
