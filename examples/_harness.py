@@ -254,6 +254,12 @@ def fold_model_diagnostics(diag, metrics=None) -> Dict[str, float]:
         out["moe.gate_entropy"] = float(host["gate_entropy"])
     if "bubble_fraction" in host:
         out["pipeline.bubble_fraction"] = float(host["bubble_fraction"])
+        if float(host.get("virtual_stages", 1)) > 1:
+            # the interleaved schedule's number, under its own name so a
+            # dashboard can read V>1 runs against the 1F1B baseline
+            out["pipeline.bubble_fraction_v"] = float(
+                host["bubble_fraction"]
+            )
     for name, v in out.items():
         metrics.gauge(name, v)
         metrics.observe(name, v)

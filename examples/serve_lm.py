@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""End-to-end example: SERVE the causal LM trained by examples/train_lm.py
+through the microbatch-streamed pipeline (ISSUE 15 / ROADMAP #2).
+
+The inference twin of the trainer: load the trainer's atomic checkpoint
+(the ONE [n_layers, ...]-stacked block pytree every mesh shares), restack
+it into S×V interleaved pipeline chunks, and answer requests one
+[mb, L+1] microbatch at a time through `models.lm.LMStream`:
+
+  - the per-call feed is exactly ONE microbatch slice riding the pipeline
+    feed ring — no request stream is ever materialized (the compiled
+    step's argument bytes are the pin, tests/test_pipeline_stream.py)
+  - streamed logits are BITWISE equal to the batch path (`pipeline_apply`
+    on the same slices) — checked here on every run, so the serving
+    surface cannot drift from the trained graph
+  - requests/s and per-request latency are measured and reported, and the
+    `serve.requests` counter / `serve.latency` histogram feed the flight
+    recorder like every other stage
+
+Run on any JAX backend; for a local simulation (after train_lm):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/train_lm.py --mesh dp_pp --steps 8
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/serve_lm.py --pipe 2 --virtual 2
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+import tpu_tfrecord
+
+# Without this, a dead device tunnel makes backend discovery hang even
+# under JAX_PLATFORMS=cpu — see ensure_jax_platform.
+tpu_tfrecord.ensure_jax_platform()
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from train_lm import BATCH, SEQ_LEN, VOCAB, LMCheckpoint  # noqa: E402  (the trainer owns the model constants)
+
+from tpu_tfrecord.metrics import METRICS  # noqa: E402
+from tpu_tfrecord.models import lm  # noqa: E402
+from tpu_tfrecord.tpu import create_mesh  # noqa: E402
+
+# the dp_pp trainer's depth (train_lm.pick_mesh): the checkpoint this
+# example loads carries 4 stacked blocks
+N_LAYERS = 4
+
+
+def serve(stream: "lm.LMStream", requests) -> dict:
+    """Push every request through the stream, collecting outputs FIFO and
+    per-request latency (submit -> pop). Returns outputs + timings."""
+    outs, lat, submit_t = [], [], []
+    t0 = time.perf_counter()
+
+    def collect(ready):
+        now = time.perf_counter()
+        for o in ready:
+            lat.append(now - submit_t[len(outs)])
+            outs.append(o)
+            METRICS.count("serve.requests")
+            METRICS.observe("serve.latency", lat[-1])
+
+    for r in requests:
+        submit_t.append(time.perf_counter())
+        collect(stream.submit(r))
+    collect(stream.flush())
+    wall = time.perf_counter() - t0
+    return {"outs": outs, "latencies": lat, "wall_s": wall}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ckpt-dir", default="/tmp/tpu_tfrecord_lm/ckpt",
+                    help="train_lm's checkpoint dir (lm_state.npz)")
+    ap.add_argument("--pipe", type=int, default=2, metavar="S",
+                    help="pipeline stages (devices)")
+    ap.add_argument("--virtual", type=int, default=2, metavar="V",
+                    help="interleaved virtual stages per device "
+                         "(n_layers must divide by S*V)")
+    ap.add_argument("--requests", type=int, default=32, metavar="N",
+                    help="streamed microbatches to serve (timed pass)")
+    ap.add_argument("--mb", type=int, default=8,
+                    help="sequences per request microbatch")
+    args = ap.parse_args()
+
+    cfg = lm.LMConfig(
+        vocab_size=VOCAB, d_model=64, n_heads=4, n_layers=N_LAYERS,
+        max_len=SEQ_LEN, n_micro=BATCH // args.mb, n_virtual=args.virtual,
+    )
+    n_dev = len(jax.devices())
+    if args.pipe > n_dev:
+        ap.error(f"--pipe {args.pipe} exceeds {n_dev} devices")
+    mesh = create_mesh({"pipe": args.pipe}, jax.devices()[: args.pipe])
+
+    # the trainer's checkpoint: params + opt state in one atomic npz; the
+    # serving path wants only the params half of the (params, opt) tuple
+    template = lm.init_params(jax.random.key(0), cfg)
+    ck = LMCheckpoint(os.path.join(args.ckpt_dir, "lm_state.npz"))
+    import optax
+
+    tx = optax.adam(3e-3)
+    step, (params, _opt), _payload = ck.load((template, tx.init(template)))
+    if step is None:
+        print(f"no checkpoint at {ck.path} — run train_lm first",
+              file=sys.stderr)
+        sys.exit(1)
+    params = jax.tree.map(np.asarray, params)
+    print(f"serving checkpoint step {step} on pipe={args.pipe} "
+          f"virtual={args.virtual} mb={args.mb}")
+
+    stream = lm.LMStream(params, cfg, mesh, pipe_axis="pipe")
+    reqs = [
+        lm.make_synthetic_tokens(cfg, args.mb, seed=1000 + i)
+        for i in range(args.requests)
+    ]
+
+    # warmup pass: compiles the embed/head/step programs and fills the
+    # pipeline once; then reset and measure a clean serve
+    serve(stream, reqs[: min(len(reqs), args.pipe * args.virtual + 2)])
+    stream.reset()
+    res = serve(stream, reqs)
+    outs, lat = res["outs"], res["latencies"]
+    assert len(outs) == len(reqs), (len(outs), len(reqs))
+
+    # the serving surface may not drift from the trained graph: streamed
+    # logits must equal the batch path (batch-mode pipeline_apply over
+    # the same slices) BITWISE
+    ref = stream.batch_reference(reqs)
+    identical = all(np.array_equal(a, b) for a, b in zip(outs, ref))
+    assert identical, "streamed logits diverged from the batch path"
+
+    line = {
+        "requests": len(reqs),
+        "requests_per_s": round(len(reqs) / res["wall_s"], 1),
+        "sequences_per_s": round(len(reqs) * args.mb / res["wall_s"], 1),
+        "latency_ms_p50": round(
+            float(np.percentile(lat, 50)) * 1e3, 2
+        ),
+        "latency_ms_p99": round(
+            float(np.percentile(lat, 99)) * 1e3, 2
+        ),
+        "byte_identical_to_batch": identical,
+        "ckpt_step": step,
+        "shape": f"mb={args.mb} L={SEQ_LEN} S={args.pipe} V={args.virtual}",
+    }
+    print("serve_lm OK:", json.dumps(line), flush=True)
+
+
+if __name__ == "__main__":
+    main()

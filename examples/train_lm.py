@@ -94,13 +94,18 @@ def generate(data_dir: str, shards: int = 4, docs: int = 256) -> None:
     ).write_rows(rows)
 
 
-def pick_mesh(kind: str):
+def pick_mesh(kind: str, virtual: int = 1):
     """(mesh, cfg axes, n_layers) for the requested parallelism on however
-    many devices exist (odd counts degrade to dp)."""
+    many devices exist (odd counts degrade to dp). ``virtual`` > 1 picks
+    the interleaved dp_pp shape: 2 stages × V round-robin chunks of the
+    same 4 layers, cutting the bubble toward (S-1)/(V·M+S-1)."""
     n_dev = len(jax.devices())
     if kind == "dp_sp" and n_dev % 2 == 0:
         mesh = create_mesh({"data": n_dev // 2, "seq": 2})
         return mesh, {"data_axis": "data", "seq_axis": "seq"}, 2
+    if kind == "dp_pp" and virtual > 1 and n_dev % 2 == 0:
+        mesh = create_mesh({"pipe": 2, "data": n_dev // 2})
+        return mesh, {"data_axis": "data", "pipe_axis": "pipe"}, 4
     if kind == "dp_pp" and n_dev % 4 == 0:
         mesh = create_mesh({"pipe": 4, "data": n_dev // 4})
         return mesh, {"data_axis": "data", "pipe_axis": "pipe"}, 4
@@ -205,16 +210,23 @@ def main() -> None:
     ap.add_argument("--moe", type=int, default=0, metavar="EXPERTS",
                     help="swap every block's FFN for a top-2 MoE with "
                          "this many experts (0 = dense; dp/dp_sp only)")
+    ap.add_argument("--virtual", type=int, default=1, choices=(1, 2),
+                    metavar="V", help="interleaved virtual stages for "
+                    "--mesh dp_pp: V round-robin layer chunks per device "
+                    "(models.pipeline), bubble -> (S-1)/(V*M+S-1)")
     args = ap.parse_args()
 
+    if args.virtual > 1 and args.mesh != "dp_pp":
+        ap.error("--virtual > 1 needs --mesh dp_pp")
     generate(args.data_dir)
-    mesh, axes, n_layers = pick_mesh(args.mesh)
+    mesh, axes, n_layers = pick_mesh(args.mesh, args.virtual)
     if args.moe and "pipe_axis" in axes:
         ap.error("--moe is not supported with --mesh dp_pp")
     cfg = lm.LMConfig(
         vocab_size=VOCAB, d_model=64, n_heads=4, n_layers=n_layers,
         max_len=SEQ_LEN, n_micro=8 if "pipe_axis" in axes else None,
         moe_experts=args.moe,
+        n_virtual=args.virtual if "pipe_axis" in axes else 1,
     )
     print(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))} "
           f"mode={args.mesh}")

@@ -67,6 +67,48 @@ class TestForwardParity:
             np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
         )
 
+    def test_interleaved_pipeline_matches_dense_reference(self):
+        """mesh(dp×pp) with n_virtual=2: each device owns 2 round-robin
+        layer chunks; the interleaved schedule must still equal the dense
+        forward, and the measured bubble must beat the 1F1B analytic."""
+        cfg = lm.LMConfig(
+            vocab_size=64, d_model=16, n_heads=2, n_layers=4, max_len=16,
+            n_micro=4, n_virtual=2,
+        )
+        mesh = create_mesh({"pipe": 2, "data": 2}, jax.devices()[:4])
+        params = lm.init_params(jax.random.key(0), cfg)
+        toks = batch(cfg)
+        want, _ = lm.forward(params, toks, cfg)
+        p_sh = jax.device_put(
+            params, lm.param_shardings(mesh, params, pipe_axis="pipe")
+        )
+        got, _, diag = jax.jit(
+            functools.partial(
+                lm.forward, cfg=cfg, mesh=mesh, data_axis="data",
+                pipe_axis="pipe", diagnostics=True,
+            )
+        )(p_sh, toks)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+        # S=2, V=2, M=4: (S-1)/(V·M+S-1) = 1/9, below 1F1B's 1/5
+        assert float(diag["bubble_fraction"]) == pytest.approx(
+            1 / 9, abs=1e-6
+        )
+
+    def test_interleaved_layer_count_mismatch_rejected(self):
+        cfg = lm.LMConfig(
+            vocab_size=64, d_model=16, n_heads=2, n_layers=2, max_len=16,
+            n_virtual=2,
+        )
+        mesh = create_mesh({"pipe": 4, "data": 2})
+        params = lm.init_params(jax.random.key(0), cfg)
+        with pytest.raises(ValueError, match="n_virtual"):
+            lm.forward(
+                params, batch(cfg), cfg, mesh, data_axis="data",
+                pipe_axis="pipe",
+            )
+
     def test_moe_ep_matches_unsharded_moe(self):
         """expert_axis routes the FFN through the pinned all-to-all EP;
         per-shard capacity means parity holds vs moe_apply when the
@@ -154,6 +196,64 @@ class TestComposition:
             CFG, mesh=mesh, data_axis="data", seq_axis="seq"
         )
         np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+    def test_interleaved_dp_pp_trajectory_matches_pure_dp(self):
+        """Grads unperturbed by interleaving: same params + same data =>
+        same loss trajectory as pure dp, V=2."""
+        cfg = lm.LMConfig(
+            vocab_size=64, d_model=16, n_heads=2, n_layers=4, max_len=16,
+            n_micro=2, n_virtual=2,
+        )
+        ref = self._trajectory(cfg)
+        mesh = create_mesh({"pipe": 2, "data": 4})
+        got = self._trajectory(
+            cfg, mesh=mesh, data_axis="data", pipe_axis="pipe"
+        )
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+
+class TestLMStream:
+    """The serving flavor: streamed logits == the batch path bitwise, and
+    both match the dense reference."""
+
+    def _cfg(self):
+        return lm.LMConfig(
+            vocab_size=64, d_model=16, n_heads=2, n_layers=4, max_len=16,
+            n_micro=4, n_virtual=2,
+        )
+
+    def test_streamed_logits_bitwise_equal_batch_path(self):
+        cfg = self._cfg()
+        mesh = create_mesh({"pipe": 2}, jax.devices()[:2])
+        params = lm.init_params(jax.random.key(0), cfg)
+        stream = lm.LMStream(params, cfg, mesh)
+        reqs = [lm.make_synthetic_tokens(cfg, 4, seed=i) for i in range(6)]
+        outs = []
+        for r in reqs:
+            outs.extend(stream.submit(r))
+        outs.extend(stream.flush())
+        assert len(outs) == len(reqs)
+        ref = stream.batch_reference(reqs)
+        for got, want in zip(outs, ref):
+            np.testing.assert_array_equal(got, want)
+        dense_cfg = lm.LMConfig(
+            vocab_size=64, d_model=16, n_heads=2, n_layers=4, max_len=16
+        )
+        for got, r in zip(outs, reqs):
+            want, _ = lm.forward(params, jnp.asarray(r), dense_cfg)
+            np.testing.assert_allclose(
+                got, np.asarray(want), rtol=2e-4, atol=2e-4
+            )
+
+    def test_moe_rejected(self):
+        cfg = lm.LMConfig(
+            vocab_size=64, d_model=16, n_heads=2, n_layers=4, max_len=16,
+            moe_experts=4,
+        )
+        mesh = create_mesh({"pipe": 2}, jax.devices()[:2])
+        params = lm.init_params(jax.random.key(0), cfg)
+        with pytest.raises(ValueError, match="pipeline"):
+            lm.LMStream(params, cfg, mesh)
 
 
 class TestTraining:

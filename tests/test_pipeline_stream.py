@@ -1,0 +1,209 @@
+"""Microbatch-streamed serving mode (ISSUE 15): `PipelineStream` must
+serve BITWISE what batch-mode `pipeline_apply` computes on the same
+slices, with a per-call feed of exactly ONE [mb, ...] slice (no
+[M, mb, ...] stream materialized anywhere — pinned via the compiled
+step's argument bytes) and a gather-free per-tick step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from hlo_util import per_device_argument_bytes
+from test_pipeline_parallel import make_stages
+from tools.graftlint import hlo_contracts
+from tpu_tfrecord.models import pipeline
+from tpu_tfrecord.tpu import create_mesh
+
+
+def serve(stream, xs):
+    """Push every slice of xs through the stream; outputs in FIFO order."""
+    outs = []
+    for i in range(xs.shape[0]):
+        outs.extend(stream.push(xs[i]))
+    outs.extend(stream.flush())
+    return outs
+
+
+class TestStreamParity:
+    @pytest.mark.parametrize("n_stages,n_virtual,m", [
+        (4, 1, 6),    # classic schedule
+        (4, 2, 9),    # interleaved, ragged request count
+        (2, 2, 5),
+        (2, 4, 8),
+    ])
+    def test_streamed_outputs_bitwise_equal_batch_mode(
+        self, n_stages, n_virtual, m
+    ):
+        """The acceptance pin: the serving path cannot drift from the
+        trained graph — same slices, same bits."""
+        mesh = create_mesh({"pipe": n_stages}, jax.devices()[:n_stages])
+        params, stage_fn = make_stages(
+            n_stages, seed=n_stages + n_virtual, n_virtual=n_virtual
+        )
+        xs = np.random.default_rng(m).normal(size=(m, 2, 8)).astype(
+            np.float32
+        )
+        batch = np.asarray(
+            pipeline.pipeline_apply(
+                stage_fn, params, jnp.asarray(xs), mesh, n_virtual=n_virtual
+            )
+        )
+        stream = pipeline.PipelineStream(
+            stage_fn, params, mesh, n_virtual=n_virtual
+        )
+        outs = serve(stream, xs)
+        assert len(outs) == m
+        assert stream.served == m and stream.in_flight == 0
+        for i in range(m):
+            np.testing.assert_array_equal(outs[i], batch[i])
+
+    def test_reset_replays_identically(self):
+        mesh = create_mesh({"pipe": 4}, jax.devices()[:4])
+        params, stage_fn = make_stages()
+        xs = np.random.default_rng(0).normal(size=(5, 2, 8)).astype(
+            np.float32
+        )
+        stream = pipeline.PipelineStream(stage_fn, params, mesh)
+        first = serve(stream, xs)
+        stream.reset()
+        second = serve(stream, xs)
+        for a, b in zip(first, second):
+            np.testing.assert_array_equal(a, b)
+
+    def test_outputs_pop_fifo_with_pipeline_latency(self):
+        """V=1: warmup pushes return nothing, then one output pops per
+        push (steady state within a round)."""
+        s = 4
+        mesh = create_mesh({"pipe": s}, jax.devices()[:s])
+        params, stage_fn = make_stages(s)
+        stream = pipeline.PipelineStream(stage_fn, params, mesh)
+        xs = np.random.default_rng(1).normal(size=(8, 2, 8)).astype(
+            np.float32
+        )
+        per_push = [len(stream.push(xs[i])) for i in range(8)]
+        # latency S ticks: the first S - 1 pushes cannot complete
+        assert sum(per_push[: s - 1]) == 0
+        assert per_push[s:] == [1] * (8 - s)
+        assert len(stream.flush()) == 8 - sum(per_push)
+
+    def test_interleaved_outputs_pop_in_round_bursts(self):
+        """V>1: a round's outputs are born during the (V-1)·S gap ticks
+        the NEXT round's first push advances through — nothing pops
+        before push S, then pops arrive in bursts, still FIFO and still
+        all delivered."""
+        s, v = 2, 2
+        mesh = create_mesh({"pipe": s}, jax.devices()[:s])
+        params, stage_fn = make_stages(s, n_virtual=v)
+        stream = pipeline.PipelineStream(stage_fn, params, mesh, n_virtual=v)
+        xs = np.random.default_rng(2).normal(size=(8, 2, 8)).astype(
+            np.float32
+        )
+        per_push = [len(stream.push(xs[i])) for i in range(8)]
+        assert sum(per_push[:s]) == 0          # first pop at push S
+        tail = stream.flush()
+        assert sum(per_push) + len(tail) == 8  # every push answered
+        assert stream.served == 8 and stream.in_flight == 0
+
+    @pytest.mark.parametrize("n_stages,n_virtual", [(2, 1), (4, 2)])
+    def test_push_after_flush_rebases_the_schedule(
+        self, n_stages, n_virtual
+    ):
+        """A serving loop drains during idle (flush) and then accepts new
+        requests: flush advances the tick clock past the nominal next
+        injection slot, so push must re-base onto the first usable slot —
+        outputs stay exact, not silently garbage (regression: review of
+        ISSUE 15)."""
+        mesh = create_mesh({"pipe": n_stages}, jax.devices()[:n_stages])
+        params, stage_fn = make_stages(n_stages, n_virtual=n_virtual)
+        xs = np.random.default_rng(9).normal(size=(6, 2, 8)).astype(
+            np.float32
+        )
+        stream = pipeline.PipelineStream(
+            stage_fn, params, mesh, n_virtual=n_virtual
+        )
+        outs = []
+        for i in range(6):
+            outs.extend(stream.push(xs[i]))
+            if i % 2 == 0:
+                outs.extend(stream.flush())  # idle drain mid-serve
+        outs.extend(stream.flush())
+        ref = np.asarray(
+            pipeline.pipeline_apply(
+                stage_fn, params, jnp.asarray(xs), mesh,
+                n_virtual=n_virtual,
+            )
+        )
+        assert len(outs) == 6
+        for i in range(6):
+            np.testing.assert_array_equal(outs[i], ref[i])
+
+    def test_shape_change_rejected(self):
+        mesh = create_mesh({"pipe": 4}, jax.devices()[:4])
+        params, stage_fn = make_stages()
+        stream = pipeline.PipelineStream(stage_fn, params, mesh)
+        stream.push(np.zeros((2, 8), np.float32))
+        with pytest.raises(ValueError, match="one compiled step"):
+            stream.push(np.zeros((3, 8), np.float32))
+
+    def test_dtype_change_rejected(self):
+        """A same-shape push with a different dtype must fail loudly too —
+        a silent retrace would break the one-compiled-step contract and
+        the bitwise parity with the batch path."""
+        mesh = create_mesh({"pipe": 4}, jax.devices()[:4])
+        params, stage_fn = make_stages()
+        stream = pipeline.PipelineStream(stage_fn, params, mesh)
+        stream.push(np.zeros((2, 8), np.float32))
+        with pytest.raises(ValueError, match="one dtype"):
+            stream.push(np.zeros((2, 8), np.int32))
+
+
+class TestStreamScaleShape:
+    def test_per_call_feed_is_one_slice(self):
+        """The no-[M, mb, ...]-materialization pin: the compiled step's
+        per-device argument bytes are EXACTLY the stage-weight shard +
+        the carry (tick scalar + one activation slice) + ONE replicated
+        [mb, ...] feed slice — independent of how many microbatches get
+        served, because the stream never takes more."""
+        s, v, mb = 4, 2, (2, 8)
+        mesh = create_mesh({"pipe": s}, jax.devices()[:s])
+        params, stage_fn = make_stages(s, n_virtual=v)
+        p_sh = jax.device_put(params, NamedSharding(mesh, P("pipe")))
+        stream = pipeline.PipelineStream(
+            stage_fn, p_sh, mesh, n_virtual=v, microbatch_shape=mb
+        )
+        step, args = stream.step_spec()
+        slice_bytes = int(np.prod(mb)) * 4
+        weights_bytes = sum(
+            a.size * a.dtype.itemsize for a in jax.tree.leaves(params)
+        ) // s
+        expect = (
+            weights_bytes
+            + 4            # the tick counter (int32, replicated)
+            + slice_bytes  # the carry's activation slice (pipe-sharded)
+            + slice_bytes  # THE per-call feed: one [mb, ...] slice
+        )
+        assert per_device_argument_bytes(step, *args) == expect
+
+    def test_arg_bytes_flat_in_request_count(self):
+        """Serving 3 vs 30 microbatches runs the SAME compiled step with
+        the SAME per-device argument bytes — nothing accumulates."""
+        mesh = create_mesh({"pipe": 4}, jax.devices()[:4])
+        params, stage_fn = make_stages()
+        stream = pipeline.PipelineStream(stage_fn, params, mesh)
+        sizes = []
+        for m in (3, 30):
+            stream.reset()
+            xs = np.random.default_rng(m).normal(size=(m, 2, 8)).astype(
+                np.float32
+            )
+            serve(stream, xs)
+            step, args = stream.step_spec()
+            sizes.append(per_device_argument_bytes(step, *args))
+        assert sizes[0] == sizes[1], sizes
+
+    def test_hlo_gather_free(self):
+        """Per-tick step pin from the shared manifest: collective-permute
+        only — streaming adds no gather, no reduce, no all-to-all."""
+        hlo_contracts.verify("pipeline_stream_step")

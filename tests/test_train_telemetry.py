@@ -501,6 +501,40 @@ class TestLMDiagnostics:
             3 / 11, abs=1e-4
         )
 
+    def test_interleaved_lm_step_reports_v_bubble_and_folds(self):
+        """V>1 diag carries virtual_stages and folds the interleaved
+        number under its own gauge (pipeline.bubble_fraction_v) next to
+        the shared pipeline.bubble_fraction."""
+        import optax
+
+        mesh = create_mesh({"pipe": 2, "data": 4})
+        cfg = lm.LMConfig(
+            vocab_size=64, d_model=16, n_heads=2, n_layers=4, max_len=16,
+            n_micro=8, n_virtual=2,
+        )
+        params = lm.init_params(jax.random.key(0), cfg)
+        tx = optax.adam(1e-3)
+        opt = tx.init(params)
+        toks = jnp.asarray(lm.make_synthetic_tokens(cfg, 32, seed=0))
+        _, _, _, diag = lm.train_step(
+            params, opt, toks, cfg=cfg, tx=tx, mesh=mesh, data_axis="data",
+            pipe_axis="pipe", diagnostics=True,
+        )
+        # M=8, S=2, V=2 -> (S-1)/(V·M+S-1) = 1/17, below 1F1B's 1/9
+        assert float(diag["bubble_fraction"]) == pytest.approx(
+            1 / 17, abs=1e-6
+        )
+        assert float(diag["virtual_stages"]) == 2
+        m = Metrics()
+        folded = _harness.fold_model_diagnostics(diag, metrics=m)
+        assert m.gauge_value("pipeline.bubble_fraction_v") == pytest.approx(
+            1 / 17, abs=1e-4
+        )
+        assert m.gauge_value("pipeline.bubble_fraction") == pytest.approx(
+            1 / 17, abs=1e-4
+        )
+        assert "pipeline.bubble_fraction_v" in folded
+
     def test_fold_none_and_empty_are_noops(self):
         m = Metrics()
         assert _harness.fold_model_diagnostics(None, metrics=m) == {}

@@ -110,7 +110,7 @@ def _build_pipeline_feed_ring():
     xs = jnp.zeros((4, 2, 8), jnp.float32)
     p_sh = jax.device_put(params, NamedSharding(mesh, P("pipe")))
     xs_sh = jax.device_put(
-        xs, pipeline.microbatch_sharding(mesh, "pipe", ndim=xs.ndim)
+        xs, pipeline.microbatch_sharding(mesh, "pipe", ndim=xs)
     )
     fn = jax.jit(lambda p, x: pipeline.pipeline_apply(stage_fn, p, x, mesh))
     return fn, (p_sh, xs_sh)
@@ -130,7 +130,7 @@ def _build_pipeline_feed_ring_dp():
     p_sh = jax.device_put(params, NamedSharding(mesh, P("pipe")))
     xs_sh = jax.device_put(
         xs,
-        pipeline.microbatch_sharding(mesh, ndim=xs.ndim, batch_spec=P("data")),
+        pipeline.microbatch_sharding(mesh, ndim=xs, batch_spec=P("data")),
     )
     fn = jax.jit(
         lambda p, x: pipeline.pipeline_apply(
@@ -159,13 +159,69 @@ def _build_pipeline_diagnostics():
         return jnp.tanh(x @ p["w"])
 
     xs = jnp.zeros((8, 4, 8), jnp.float32)
-    xs_sh = jax.device_put(xs, pipeline.microbatch_sharding(mesh, ndim=3))
+    xs_sh = jax.device_put(xs, pipeline.microbatch_sharding(mesh, ndim=xs))
     fn = jax.jit(
         lambda p, x: pipeline.pipeline_apply(
             stage_fn, p, x, mesh, diagnostics=True
         )[0]
     )
     return fn, (params, xs_sh)
+
+
+def _build_pipeline_interleaved():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_tfrecord.models import pipeline
+    from tpu_tfrecord.tpu import create_mesh
+
+    import numpy as np
+
+    mesh = create_mesh({"pipe": 4}, jax.devices()[:4])
+    rng = np.random.default_rng(0)
+    # [S, V, ...] stage stack: device d owns the 2 round-robin chunks
+    # d and d+4 of the 8 virtual stages
+    params = {
+        "w": jnp.asarray(rng.normal(size=(4, 2, 8, 8)) * 0.5, jnp.float32),
+    }
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    xs = jnp.zeros((8, 2, 8), jnp.float32)
+    p_sh = jax.device_put(params, NamedSharding(mesh, P("pipe")))
+    xs_sh = jax.device_put(xs, pipeline.microbatch_sharding(mesh, "pipe", xs))
+    fn = jax.jit(
+        lambda p, x: pipeline.pipeline_apply(stage_fn, p, x, mesh, n_virtual=2)
+    )
+    return fn, (p_sh, xs_sh)
+
+
+def _build_pipeline_stream_step():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_tfrecord.models import pipeline
+    from tpu_tfrecord.tpu import create_mesh
+
+    import numpy as np
+
+    mesh = create_mesh({"pipe": 4}, jax.devices()[:4])
+    rng = np.random.default_rng(0)
+    params = {
+        "w": jnp.asarray(rng.normal(size=(4, 2, 8, 8)) * 0.5, jnp.float32),
+    }
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    p_sh = jax.device_put(params, NamedSharding(mesh, P("pipe")))
+    stream = pipeline.PipelineStream(
+        stage_fn, p_sh, mesh, n_virtual=2, microbatch_shape=(2, 8)
+    )
+    return stream.step_spec()
 
 
 def _moe_fixture(cfg):
@@ -278,6 +334,26 @@ CONTRACTS: Dict[str, HloContract] = {
             builder=_build_pipeline_feed_ring_dp,
             note="composing a data axis must not re-introduce a gather of "
             "the stream (all-reduce is dp's legitimate collective here)",
+        ),
+        HloContract(
+            name="pipeline_interleaved",
+            entrypoint="models.pipeline.pipeline_apply (n_virtual=2)",
+            contains=("collective-permute",),
+            absent=("all-gather", "all-reduce", "all-to-all"),
+            builder=_build_pipeline_interleaved,
+            note="interleaved virtual stages ride the SAME three O(mb) "
+            "rings: cutting the bubble by V may not re-introduce a "
+            "gather or broadcast of the stream",
+        ),
+        HloContract(
+            name="pipeline_stream_step",
+            entrypoint="models.pipeline.PipelineStream (per-tick step)",
+            contains=("collective-permute",),
+            absent=("all-gather", "all-reduce", "all-to-all"),
+            builder=_build_pipeline_stream_step,
+            note="the serving step's only data argument is ONE [mb, ...] "
+            "slice; activations still hop by neighbor permute and "
+            "nothing gathers",
         ),
         HloContract(
             name="pipeline_diagnostics",

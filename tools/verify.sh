@@ -669,6 +669,48 @@ print("lm smoke OK:", json.dumps({
 }))
 PY
 
+echo "== serving smoke (train_lm dp_pp interleaved -> serve_lm streams the checkpoint byte-identically) =="
+# The inference path end-to-end (ISSUE 15): train the LM on the dp×pp
+# interleaved mesh (2 stages × 2 virtual chunks), leave its atomic
+# checkpoint behind, then serve N streamed microbatches through LMStream.
+# serve_lm itself asserts the streamed logits equal the batch path
+# (batch-mode pipeline_apply on the same slices) BITWISE; here we pin
+# that it exits 0, reports that byte-identity, and lands a requests/s
+# number — so the serving surface can't rot.
+env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python - <<'PY' || exit 1
+import json, os, subprocess, sys, tempfile
+
+root = tempfile.mkdtemp(prefix="tfr_serve_smoke_")
+data, ck = os.path.join(root, "data"), os.path.join(root, "ckpt")
+res = subprocess.run(
+    [sys.executable, "examples/train_lm.py", "--mesh", "dp_pp",
+     "--virtual", "2", "--steps", "8", "--save-every", "4",
+     "--data-dir", data, "--ckpt-dir", ck],
+    capture_output=True, text=True, timeout=600,
+)
+assert res.returncode == 0, (res.returncode, res.stdout[-2000:], res.stderr[-1000:])
+assert os.path.exists(os.path.join(ck, "lm_state.npz")), os.listdir(ck)
+
+srv = subprocess.run(
+    [sys.executable, "examples/serve_lm.py", "--ckpt-dir", ck,
+     "--pipe", "2", "--virtual", "2", "--requests", "12"],
+    capture_output=True, text=True, timeout=600,
+)
+assert srv.returncode == 0, (srv.returncode, srv.stdout[-2000:], srv.stderr[-1000:])
+line = [l for l in srv.stdout.splitlines() if l.startswith("serve_lm OK:")]
+assert line, srv.stdout[-2000:]
+rep = json.loads(line[0].split("serve_lm OK:", 1)[1])
+assert rep["byte_identical_to_batch"] is True, rep
+assert rep["requests"] == 12 and rep["requests_per_s"] > 0, rep
+assert rep["ckpt_step"] == 8, rep
+print("serving smoke OK:", json.dumps({
+    "requests_per_s": rep["requests_per_s"],
+    "latency_ms_p50": rep["latency_ms_p50"],
+    "byte_identical": rep["byte_identical_to_batch"],
+}))
+PY
+
 echo "== trainer-telemetry smoke (train_lm --spool -> doctor train + step-marked trace + MoE counts) =="
 # The training flight recorder end-to-end: a short MoE train_lm run spools
 # under the trainer role with the flight recorder on. `doctor train` must

@@ -19,7 +19,14 @@ mesh axes the caller passes:
                                   dp×pp composed mesh; attention is dense
                                   per stage (a stage's shard_map already
                                   owns the device, so the sequence stays
-                                  whole within it)
+                                  whole within it). ``cfg.n_virtual`` > 1
+                                  interleaves V round-robin chunks per
+                                  device (models.pipeline), cutting the
+                                  bubble toward (S-1)/(V·M+S-1)
+- `LMStream`                   -> the SERVING flavor: the same pipelined
+                                  chunks behind a per-microbatch streamed
+                                  step (push one [mb, L+1] request, pop
+                                  logits), bitwise the batch path
 - ``expert_axis``              -> every block's FFN swaps for the top-k
                                   MoE with the PINNED all-to-all dispatch
                                   (`moe_apply_ep`)
@@ -71,6 +78,11 @@ class LMConfig:
     # microbatches for the pipeline mode (must divide the batch); None =
     # 2 × pipe-axis size (a 2-slice block per device, 2/3 efficiency)
     n_micro: Optional[int] = None
+    # interleaved virtual stages for the pipeline mode (GSPMD-style,
+    # models.pipeline): device d owns V round-robin layer chunks
+    # (d, d+S, ...), shrinking the bubble toward (S-1)/(V·M+S-1);
+    # n_layers must divide by S·V
+    n_virtual: int = 1
 
 
 def _dense_init(rng, fan_in: int, fan_out: int):
@@ -180,6 +192,81 @@ def _block(
     )
 
 
+def _embed_tokens(params, tokens, cfg: LMConfig):
+    """tokens [B, L+1] int32 -> x [B, L, D]: the model reads
+    tokens[:, :-1]. Shared by the batch forward and the streamed server
+    (LMStream) — one embedding program, no drift between paths."""
+    dt = cfg.dtype
+    x_tok = tokens[:, :-1]
+    l = x_tok.shape[1]
+    if l != cfg.max_len:
+        raise ValueError(
+            f"packed batch carries {l} input tokens but cfg.max_len is "
+            f"{cfg.max_len} (the packer's seq_len must match)"
+        )
+    return (
+        params["embed"].astype(dt)[x_tok]
+        + params["pos"][:l].astype(dt)[None]
+    )
+
+
+def _head_logits(params, x, cfg: LMConfig):
+    """Final-norm + LM head: [.., L, D] -> f32 logits [.., L, V]. Shared
+    by the batch forward and LMStream."""
+    return _dense(params["head"], _rms_norm(x), cfg.dtype).astype(
+        jnp.float32
+    )
+
+
+def _chunk_count(cfg: LMConfig, n_stages: int) -> int:
+    chunks = n_stages * cfg.n_virtual
+    if cfg.n_layers % chunks:
+        raise ValueError(
+            f"n_layers ({cfg.n_layers}) must divide into the pipe axis × "
+            f"n_virtual ({n_stages} stages × {cfg.n_virtual} virtual = "
+            f"{chunks} chunks)"
+        )
+    return chunks
+
+
+def _stage_stack(blocks, cfg: LMConfig, n_stages: int):
+    """The stacked [n_layers, ...] block pytree in the pipeline's stage
+    layout: [S, per_stage, ...] classic, or [S, V, per_chunk, ...]
+    interleaved — virtual stage k = v·S + s (device s's chunk v) holds
+    layers [k·pc, (k+1)·pc), the GSPMD round-robin assignment (device d
+    owns layer chunks d, d+S, d+2S, …). The V>1 relayout is a strided
+    transpose: place/checkpoint params in the canonical [n_layers, ...]
+    stack and let XLA move them once per step, or pre-place the reshaped
+    stack (LMStream does, serving from the same checkpoint)."""
+    chunks = _chunk_count(cfg, n_stages)
+    pc = cfg.n_layers // chunks
+    if cfg.n_virtual == 1:
+        return jax.tree.map(
+            lambda a: a.reshape((n_stages, pc) + a.shape[1:]), blocks
+        )
+    v = cfg.n_virtual
+    return jax.tree.map(
+        lambda a: a.reshape((v, n_stages, pc) + a.shape[1:]).transpose(
+            (1, 0) + tuple(range(2, a.ndim + 2))
+        ),
+        blocks,
+    )
+
+
+def _make_stage_fn(cfg: LMConfig):
+    """One pipeline chunk: per_chunk decoder blocks, dense attention (a
+    stage's shard_map already owns the device — the sequence stays whole
+    within it)."""
+    def stage_fn(p_chunk, xs):
+        pc = jax.tree.leaves(p_chunk)[0].shape[0]
+        for j in range(pc):
+            layer = jax.tree.map(lambda a: a[j], p_chunk)
+            xs, _, _ = _block(layer, xs, cfg)
+        return xs
+
+    return stage_fn
+
+
 def forward(
     params: Dict[str, Any],
     tokens: jax.Array,
@@ -214,47 +301,24 @@ def forward(
         raise ValueError(
             "moe_experts > 0 is not supported in the pipeline mode"
         )
-    dt = cfg.dtype
-    x_tok = tokens[:, :-1]
-    b, l = x_tok.shape
-    if l != cfg.max_len:
-        raise ValueError(
-            f"packed batch carries {l} input tokens but cfg.max_len is "
-            f"{cfg.max_len} (the packer's seq_len must match)"
-        )
-    x = (
-        params["embed"].astype(dt)[x_tok]
-        + params["pos"][:l].astype(dt)[None]
-    )                                                          # [B, L, D]
+    b = tokens.shape[0]
+    # _embed_tokens owns the max_len validation
+    x = _embed_tokens(params, tokens, cfg)                     # [B, L, D]
     aux_total = jnp.float32(0.0)
     diag: Dict[str, jax.Array] = {}
     if pipe_axis is not None:
         n_stages = mesh.shape[pipe_axis]
-        if cfg.n_layers % n_stages:
-            raise ValueError(
-                f"n_layers ({cfg.n_layers}) must divide into the pipe "
-                f"axis ({n_stages} stages)"
-            )
-        per_stage = cfg.n_layers // n_stages
-        stage_params = jax.tree.map(
-            lambda a: a.reshape((n_stages, per_stage) + a.shape[1:]),
-            params["blocks"],
-        )
+        stage_params = _stage_stack(params["blocks"], cfg, n_stages)
         m = cfg.n_micro or 2 * n_stages
         if b % m:
             raise ValueError(f"batch {b} not divisible by n_micro {m}")
-
-        def stage_fn(p_stage, xs):
-            for j in range(per_stage):
-                layer = jax.tree.map(lambda a: a[j], p_stage)
-                xs, _, _ = _block(layer, xs, cfg)
-            return xs
-
+        stage_fn = _make_stage_fn(cfg)
         xs = x.reshape((m, b // m) + x.shape[1:])              # [M, mb, L, D]
         batch_spec = P(data_axis) if data_axis else P()
         out = _pipeline.pipeline_apply(
             stage_fn, stage_params, xs, mesh, pipe_axis=pipe_axis,
-            batch_spec=batch_spec, diagnostics=diagnostics,
+            batch_spec=batch_spec, n_virtual=cfg.n_virtual,
+            diagnostics=diagnostics,
         )
         if diagnostics:
             xs, diag = out
@@ -286,7 +350,7 @@ def forward(
                 "gate_entropy":
                     sum(d["gate_entropy"] for d in moe_diags) / n,
             }
-    logits = _dense(params["head"], _rms_norm(x), dt).astype(jnp.float32)
+    logits = _head_logits(params, x, cfg)
     if diagnostics:
         return logits, aux_total, diag
     return logits, aux_total
@@ -348,7 +412,13 @@ def param_shardings(
 ):
     """Replicate everything except what a mode shards: the stacked block
     dim on ``pipe_axis`` (stage weights never replicate — that is PP), the
-    expert dim on ``expert_axis`` (that is EP)."""
+    expert dim on ``expert_axis`` (that is EP).
+
+    The checkpoint keeps the canonical [n_layers, ...] stack under every
+    mode; with ``cfg.n_virtual`` > 1 the forward's `_stage_stack` does
+    the round-robin chunk relayout in-jit (XLA moves the weights once per
+    step) — serving avoids even that by pre-placing the reshaped stack
+    (LMStream)."""
     repl = NamedSharding(mesh, P())
 
     def blocks_spec(path_leaf):
@@ -374,6 +444,92 @@ def param_shardings(
 def batch_shardings(mesh: Mesh, data_axis: str = "data"):
     """Packed token batches shard their batch dim on the data axis."""
     return {"tokens": NamedSharding(mesh, P(data_axis, None))}
+
+
+class LMStream:
+    """Microbatch-streamed LM inference — the serving flavor of the
+    pipeline mode (ROADMAP #2's heavy-traffic path).
+
+    Wraps `models.pipeline.PipelineStream` around the SAME decoder chunks
+    the pipelined trainer runs: blocks from the trainer's checkpoint
+    layout ([n_layers, ...] stacked — `examples/train_lm.py`'s npz loads
+    straight in) are re-stacked into the stage layout host-side and
+    device_put sharded on the pipe axis, so serving pays the V>1
+    round-robin relayout ONCE at startup instead of per step. Embedding
+    and head run per-microbatch in their own tiny jits (the exact
+    programs the batch forward uses).
+
+    Per request: ``submit(tokens [mb, L+1])`` feeds ONE microbatch-sized
+    slice (the per-call pin — no request stream is ever materialized) and
+    returns whatever logits completed, FIFO; ``flush()`` drains the tail.
+    Streamed logits are BITWISE equal to `batch_reference` — the batch
+    path over `pipeline_apply` on the same slices (pinned by tests), so
+    the serving surface cannot drift from the trained graph.
+    """
+
+    def __init__(
+        self,
+        params: Dict[str, Any],
+        cfg: LMConfig,
+        mesh: Mesh,
+        pipe_axis: str = "pipe",
+    ):
+        self.cfg = cfg
+        self._n_stages = mesh.shape[pipe_axis]
+        _chunk_count(cfg, self._n_stages)
+        if cfg.moe_experts > 0:
+            raise ValueError(
+                "moe_experts > 0 is not supported in the pipeline mode"
+            )
+        self._stage_fn = _make_stage_fn(cfg)
+        self._stage_params = jax.device_put(
+            _stage_stack(params["blocks"], cfg, self._n_stages),
+            NamedSharding(mesh, P(pipe_axis)),
+        )
+        self._ep = {"embed": params["embed"], "pos": params["pos"]}
+        self._hp = {"head": params["head"]}
+        self._embed = jax.jit(lambda p, t: _embed_tokens(p, t, cfg))
+        self._head = jax.jit(lambda p, x: _head_logits(p, x, cfg))
+        self._mesh = mesh
+        self._pipe_axis = pipe_axis
+        self.stream = _pipeline.PipelineStream(
+            self._stage_fn, self._stage_params, mesh, pipe_axis=pipe_axis,
+            n_virtual=cfg.n_virtual,
+        )
+
+    def submit(self, tokens) -> list:
+        """One request: tokens [mb, L+1] int32 in, zero or more finished
+        [mb, L, V] f32 logits out (FIFO — outputs lag by the pipeline's
+        S·V-tick latency)."""
+        x = self._embed(self._ep, jnp.asarray(tokens))
+        return [
+            np.asarray(self._head(self._hp, o)) for o in self.stream.push(x)
+        ]
+
+    def flush(self) -> list:
+        """Drain the in-flight tail; returns the remaining logits FIFO."""
+        return [
+            np.asarray(self._head(self._hp, o)) for o in self.stream.flush()
+        ]
+
+    def reset(self) -> None:
+        self.stream.reset()
+
+    def batch_reference(self, batches) -> list:
+        """The batch path on the same slices: the SAME embed/head jits
+        around batch-mode `pipeline_apply` over the stacked [M, mb, ...]
+        stream — what the streamed outputs must equal bitwise."""
+        xs = jnp.stack(
+            [self._embed(self._ep, jnp.asarray(t)) for t in batches]
+        )
+        out = _pipeline.pipeline_apply(
+            self._stage_fn, self._stage_params, xs, self._mesh,
+            pipe_axis=self._pipe_axis, n_virtual=self.cfg.n_virtual,
+        )
+        return [
+            np.asarray(self._head(self._hp, out[i]))
+            for i in range(len(batches))
+        ]
 
 
 def make_synthetic_tokens(

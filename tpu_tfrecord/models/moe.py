@@ -36,7 +36,10 @@ formulation, arXiv:2101.03961 §2.2, top-k per GShard arXiv:2006.16668):
     contract `moe_apply` claims but cannot pin. Capacity is per
     (expert, token-shard): each shard applies its own ceil(Tl·cf·k/E)
     budget — the real distributed Switch semantics, mirrored exactly by
-    ``moe_reference(shards=P)``.
+    ``moe_reference(shards=P)``. Its per-device body is exposed as
+    `moe_ep_body` so EP composes under an ENCLOSING shard_map — the
+    interleaved pipeline (models.pipeline, ``param_spec``) runs it as a
+    virtual-stage chunk on a pipe×expert mesh, all-to-all intact.
 - the router adds the standard load-balance auxiliary loss (mean fraction
   of FIRST-choice assignments * mean router prob per expert, scaled by E)
   so training spreads tokens.
@@ -301,6 +304,77 @@ def moe_apply(
     return y, aux, _diag_dict(*diag, n_tokens)
 
 
+def moe_ep_body(
+    params_local: Dict[str, Any],
+    x_local: jax.Array,
+    cfg: MoEConfig,
+    expert_axis: str,
+    data_axis: Optional[str] = None,
+    valid_local: Optional[jax.Array] = None,
+    diagnostics: bool = False,
+):
+    """The per-device EP body — the all-to-all dispatch WITHOUT the
+    enclosing shard_map, so EP composes under someone else's manual mesh
+    (the interleaved pipeline runs it inside a pipe×V×expert shard_map as
+    a virtual-stage chunk; `moe_apply_ep` is this body wrapped in its own
+    shard_map).
+
+    Call it only inside a shard_map whose mesh carries ``expert_axis``.
+    ``params_local`` holds THIS device's expert shard ([E/P, ...] w_in /
+    w_out, replicated router); ``x_local`` is this device's token shard
+    [..., T_local, D] (leading dims flattened into the token count, which
+    sets the per-shard capacity budget). Returns (y, aux) with y shaped
+    like ``x_local`` — or (y, aux, diag) with ``diagnostics``, the
+    `_diag_dict` contract psum'd over ``expert_axis`` (+ ``data_axis``)
+    so the ratios are global, exactly like `moe_apply_ep`'s."""
+    xt = x_local.reshape(-1, x_local.shape[-1])
+    vf = (
+        valid_local.reshape(-1).astype(jnp.float32)
+        if valid_local is not None else None
+    )
+    c = _capacity(xt.shape[0], cfg)
+    # THE exchange around the shared per-shard body: slice the expert
+    # dim P ways, every device keeps its E/P experts and receives the
+    # matching [E, C, D] capacity slices from all peers (concat on the
+    # capacity dim -> [E/P, P*C, D]); the inverse brings expert
+    # outputs back to the token-owning device — tokens move, weights
+    # never do
+    exchange = (
+        lambda a: jax.lax.all_to_all(
+            a, expert_axis, split_axis=0, concat_axis=1, tiled=True
+        ),
+        lambda a: jax.lax.all_to_all(
+            a, expert_axis, split_axis=1, concat_axis=0, tiled=True
+        ),
+    )
+    y, (assign_sum, prob_sum, n_tok), diag = _moe_local(
+        params_local, xt, cfg, vf, c=c, exchange=exchange,
+        diagnostics=diagnostics,
+    )
+    # aux loss over the GLOBAL token stream: tiny [E] reductions
+    axes = (expert_axis,) + ((data_axis,) if data_axis else ())
+    aux = _aux_loss(
+        jax.lax.psum(assign_sum, axes),
+        jax.lax.psum(prob_sum, axes),
+        jax.lax.psum(n_tok, axes),
+        cfg.n_experts,
+    )
+    out = (
+        y.reshape(x_local.shape).astype(x_local.dtype),
+        aux.astype(jnp.float32),
+    )
+    if not diagnostics:
+        return out
+    routed, kept, ent_sum = diag
+    # GLOBAL diagnostics: psum the sums, THEN form the ratios
+    return out + (_diag_dict(
+        jax.lax.psum(routed, axes),
+        jax.lax.psum(kept, axes),
+        jax.lax.psum(ent_sum, axes),
+        jax.lax.psum(n_tok, axes),
+    ),)
+
+
 def moe_apply_ep(
     params: Dict[str, Any],
     x: jax.Array,
@@ -346,60 +420,18 @@ def moe_apply_ep(
             f"moe_apply_ep needs the token dim % mesh['{expert_axis}'] == 0 "
             f"(got T={t_dim}, axis size {p}); pad or re-bucket the stream"
         )
-    # per-shard token count is static: the local capacity budget
+    # per-shard token count is static inside the body: the local capacity
+    # budget (moe_ep_body derives it from its shard's flattened shape)
     lead = x.shape[:-2]
     dp = (data_axis,) if data_axis is not None and lead else ()
     x_spec = P(*dp, *([None] * (len(lead) - len(dp))), expert_axis, None)
     v_spec = P(*dp, *([None] * (len(lead) - len(dp))), expert_axis)
-    t_local = t_dim // p
-    batch_local = 1
-    for dim, ax in zip(lead, (dp + (None,) * len(lead))[: len(lead)]):
-        batch_local *= dim // (mesh.shape[ax] if ax else 1)
-    c = _capacity(batch_local * t_local, cfg)
 
     def body(params_l, x_l, valid_l=None):
-        xt = x_l.reshape(-1, x_l.shape[-1])
-        vf = (
-            valid_l.reshape(-1).astype(jnp.float32)
-            if valid_l is not None else None
+        return moe_ep_body(
+            params_l, x_l, cfg, expert_axis, data_axis=data_axis,
+            valid_local=valid_l, diagnostics=diagnostics,
         )
-        # THE exchange around the shared per-shard body: slice the expert
-        # dim P ways, every device keeps its E/P experts and receives the
-        # matching [E, C, D] capacity slices from all peers (concat on the
-        # capacity dim -> [E/P, P*C, D]); the inverse brings expert
-        # outputs back to the token-owning device — tokens move, weights
-        # never do
-        exchange = (
-            lambda a: jax.lax.all_to_all(
-                a, expert_axis, split_axis=0, concat_axis=1, tiled=True
-            ),
-            lambda a: jax.lax.all_to_all(
-                a, expert_axis, split_axis=1, concat_axis=0, tiled=True
-            ),
-        )
-        y, (assign_sum, prob_sum, n_tok), diag = _moe_local(
-            params_l, xt, cfg, vf, c=c, exchange=exchange,
-            diagnostics=diagnostics,
-        )
-        # aux loss over the GLOBAL token stream: tiny [E] reductions
-        axes = (expert_axis,) + ((data_axis,) if data_axis else ())
-        aux = _aux_loss(
-            jax.lax.psum(assign_sum, axes),
-            jax.lax.psum(prob_sum, axes),
-            jax.lax.psum(n_tok, axes),
-            e,
-        )
-        out = (y.reshape(x_l.shape).astype(x_l.dtype), aux.astype(jnp.float32))
-        if not diagnostics:
-            return out
-        routed, kept, ent_sum = diag
-        # GLOBAL diagnostics: psum the sums, THEN form the ratios
-        return out + (_diag_dict(
-            jax.lax.psum(routed, axes),
-            jax.lax.psum(kept, axes),
-            jax.lax.psum(ent_sum, axes),
-            jax.lax.psum(n_tok, axes),
-        ),)
 
     w_spec = {
         "router": P(),

@@ -115,6 +115,8 @@ COUNTERS: Dict[str, str] = {
     "elastic.verdict_errors": "fleet verdict reads that failed (not idle)",
     # -- training flight recorder
     "train.steps": "completed harness train steps",
+    # -- streamed serving (pipeline inference mode)
+    "serve.requests": "microbatches served by the streaming pipeline",
 }
 
 #: Throughput stages (``Metrics.add``/``timed``) and observe-only histogram
@@ -147,6 +149,9 @@ STAGES: Dict[str, str] = {
     "moe.gate_entropy": "router gate entropy per step",
     "moe.expert_imbalance": "max/mean routed tokens across experts",
     "pipeline.bubble_fraction": "pipeline schedule idle-tick fraction",
+    "pipeline.bubble_fraction_v": "interleaved (V>1) schedule bubble fraction",
+    # streamed serving: a real latency histogram (not dimensionless)
+    "serve.latency": "one streamed microbatch, push -> logits pop",
 }
 
 #: Instantaneous gauges (``Metrics.gauge``): last write wins.
@@ -165,6 +170,7 @@ GAUGES: Dict[str, str] = {
     "moe.gate_entropy": "latest per-step router gate entropy",
     "moe.expert_imbalance": "latest per-step expert imbalance",
     "pipeline.bubble_fraction": "latest per-step pipeline bubble fraction",
+    "pipeline.bubble_fraction_v": "latest interleaved (V>1) bubble fraction",
 }
 
 #: Trace span / instant names (``telemetry.span``/``instant``/
