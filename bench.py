@@ -1569,6 +1569,140 @@ def _model_parallel_probe() -> dict:
     }
 
 
+def _ckpt_probe() -> dict:
+    """Async vs sync checkpointing A/B (ISSUE 16, device-free, ~3s).
+
+    A synthetic train loop (fixed busy-compute per step, fixed save
+    cadence) checkpoints a model-shaped pytree through AsyncCheckpointer
+    twice under a SEEDED commit throttle (commit_delay_s — the slow-disk
+    fault): the sync twin pays the throttle on the step path and must
+    verdict ckpt_bound; the async path pays only the snapshot and must
+    stay compute_bound, with the restored state byte-identical between
+    the two. Then the real (unthrottled) commit p99 on all three artifact
+    paths: the sharded model pytree, the train_lm-shaped npz twin
+    (params+opt leaves + input/packer payload), and the O(1) input-state
+    JSON (AsyncStateSaver)."""
+    import shutil
+    import sys as _sys
+    import tempfile
+
+    _sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "examples"
+    ))
+    import _harness
+
+    from tpu_tfrecord.checkpoint import AsyncCheckpointer, AsyncStateSaver
+    from tpu_tfrecord.io.dataset import IteratorState
+    from tpu_tfrecord.metrics import Metrics
+
+    rng = np.random.default_rng(0)
+    state = {
+        "w": rng.standard_normal((128, 256)).astype(np.float32),
+        "b": rng.standard_normal(256).astype(np.float32),
+    }
+    throttle = float(os.environ.get("TFR_BENCH_CKPT_THROTTLE_S", 0.03))
+    steps = int(os.environ.get("TFR_BENCH_CKPT_STEPS", 24))
+    cadence = 4
+    spin = rng.standard_normal((160, 160)).astype(np.float32)
+    compute_s = 0.010
+
+    def busy():
+        # fixed-duration host compute (the "device step" stand-in)
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < compute_s:
+            np.dot(spin, spin)
+
+    def leg(sync: bool, root: str):
+        m = Metrics()
+        ck = AsyncCheckpointer(
+            os.path.join(root, "sync" if sync else "async"),
+            process_index=0, process_count=1, sync=sync,
+            commit_delay_s=throttle, metrics=m,
+        )
+        rec = _harness.StepPhases(window=16)
+        for step in range(1, steps + 1):
+            with rec.phase("compute"):
+                busy()
+            if step % cadence == 0:
+                with rec.phase("ckpt"):
+                    ck.save(step, state, {"step": step})
+            rec.end_step()
+        ck.wait()
+        restored = ck.restore({k: np.zeros_like(v) for k, v in state.items()})
+        ck.close()
+        return rec, m, restored
+
+    root = tempfile.mkdtemp(prefix="tfr_bench_ckpt_")
+    try:
+        sync_rec, _, sync_restored = leg(True, root)
+        async_rec, async_m, async_restored = leg(False, root)
+        resume_equal = sync_restored[0] == async_restored[0] and all(
+            np.array_equal(sync_restored[1][k], async_restored[1][k])
+            for k in state
+        )
+
+        def commit_p99_ms(m: Metrics) -> float:
+            q = m.quantiles("ckpt.commit").get("ckpt.commit")
+            return round(q["p99_s"] * 1000.0, 2) if q else 0.0
+
+        # unthrottled commit p99 per artifact path
+        m_pytree = Metrics()
+        with AsyncCheckpointer(
+            os.path.join(root, "p_pytree"), process_index=0,
+            process_count=1, commit_delay_s=0.0, metrics=m_pytree,
+        ) as ck:
+            for step in range(1, 9):
+                ck.save(step * cadence, state, None)
+            ck.wait()
+        lm_state = (state, {"mu": np.zeros_like(state["w"])})
+        m_npz = Metrics()
+        with AsyncCheckpointer(
+            os.path.join(root, "p_npz"), process_index=0,
+            process_count=1, commit_delay_s=0.0, metrics=m_npz,
+        ) as ck:
+            for step in range(1, 9):
+                ck.save(
+                    step * cadence, lm_state,
+                    {"input": {"epoch": 0, "shard_cursor": step},
+                     "packer": {"carry": [step]}},
+                )
+            ck.wait()
+        m_state = Metrics()
+        with AsyncStateSaver(
+            os.path.join(root, "p_state"), process_index=0,
+            commit_delay_s=0.0, metrics=m_state,
+        ) as saver:
+            for step in range(1, 9):
+                saver.save(
+                    IteratorState(shard_cursor=step, record_offset=step * 7),
+                    step=step * cadence,
+                )
+            saver.wait()
+
+        wait_stats = async_m.snapshot().get("ckpt.commit_wait", {})
+        return {
+            "ckpt_sync_share": round(sync_rec.shares().get("ckpt", 0.0), 4),
+            "ckpt_async_share": round(async_rec.shares().get("ckpt", 0.0), 4),
+            "ckpt_commit_p99_ms_pytree": commit_p99_ms(m_pytree),
+            "ckpt_commit_p99_ms_npz": commit_p99_ms(m_npz),
+            "ckpt_commit_p99_ms_state": commit_p99_ms(m_state),
+            "ckpt": {
+                "sync_verdict": sync_rec.verdict(),
+                "async_verdict": async_rec.verdict(),
+                "resume_equal": resume_equal,
+                "commit_throttle_s": throttle,
+                "cadence": cadence,
+                "steps": steps,
+                "async_commit_wait_ms": round(
+                    wait_stats.get("seconds", 0.0) * 1000.0, 2
+                ),
+                "async_commit_waits": int(wait_stats.get("records", 0)),
+            },
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 # Self-flagging regression check (ROADMAP #5): the artifact compares its
 # own numbers against the previous round's and flags anything outside a
 # per-field noise band — r5's host_side 1.32M vs r4's 1.51M went
@@ -1598,6 +1732,26 @@ _PREV_NOISE_BANDS = {
     "cold_value": 0.50,
     "value": 0.35,
     "sustained_value": 0.50,
+    # async checkpointing A/B (ISSUE 16). ckpt_sync_share is the CONTRAST
+    # guard (bigger is better: a drop means the seeded throttle stopped
+    # biting and the A/B lost its meaning); the async share and the
+    # commit p99s are smaller-is-better (see _SMALLER_IS_BETTER) — a rise
+    # is the regression. The async share sits near 0 so its ratio noise
+    # is huge; the wide band only fires when it blows up outright.
+    "ckpt_sync_share": 0.50,
+    "ckpt_async_share": 2.00,
+    "ckpt_commit_p99_ms_pytree": 0.50,
+    "ckpt_commit_p99_ms_npz": 0.50,
+    "ckpt_commit_p99_ms_state": 0.50,
+}
+
+#: Fields where SMALLER is better: _vs_previous inverts the flag logic
+#: (delta above the band = regression, below = improvement).
+_SMALLER_IS_BETTER = {
+    "ckpt_async_share",
+    "ckpt_commit_p99_ms_pytree",
+    "ckpt_commit_p99_ms_npz",
+    "ckpt_commit_p99_ms_state",
 }
 
 
@@ -1664,11 +1818,18 @@ def _vs_previous(current: dict):
         if not isinstance(p, (int, float)) or not isinstance(c, (int, float)) or not p:
             continue
         delta = c / p - 1.0
-        flag = (
-            "regression"
-            if delta < -band
-            else ("improvement" if delta > band else "within_noise")
-        )
+        if field in _SMALLER_IS_BETTER:
+            flag = (
+                "regression"
+                if delta > band
+                else ("improvement" if delta < -band else "within_noise")
+            )
+        else:
+            flag = (
+                "regression"
+                if delta < -band
+                else ("improvement" if delta > band else "within_noise")
+            )
         if flag == "regression":
             regressions.append(field)
         fields[field] = {
@@ -1779,6 +1940,11 @@ def main() -> None:
         # elastic decode fleet: worker count tracks offered load, drains
         # on load removal (~16s, device-free) — ISSUE 12
         elastic_info = _elastic_probe()
+    ckpt_info = None
+    if os.environ.get("TFR_BENCH_CKPT", "1") != "0":
+        # async vs sync checkpoint A/B under a seeded commit throttle +
+        # unthrottled commit p99 per artifact path (~3s, device-free)
+        ckpt_info = _ckpt_probe()
     scaling_info = None
     if os.environ.get("TFR_BENCH_SCALING", "1") != "0":
         # workers->ex/s sweep, appended to PARITY.md as the round trend
@@ -1823,7 +1989,8 @@ def main() -> None:
             for extra in (cold_info, remote_info, remote_http_info,
                           stall_info, warm_info, telemetry_info,
                           seq_host_info, autotune_info, service_info,
-                          elastic_info, scaling_info, model_parallel_info):
+                          elastic_info, ckpt_info, scaling_info,
+                          model_parallel_info):
                 if extra is not None:
                     out.update(extra)
             _attach_regression_verdict(out)
@@ -1839,7 +2006,8 @@ def main() -> None:
         for extra in (cold_info, remote_info, remote_http_info,
                       stall_info, warm_info, telemetry_info,
                       seq_host_info, autotune_info, service_info,
-                      elastic_info, scaling_info, model_parallel_info):
+                      elastic_info, ckpt_info, scaling_info,
+                      model_parallel_info):
             if extra is not None:
                 err.update(extra)
         _attach_regression_verdict(err)
@@ -2237,6 +2405,10 @@ def main() -> None:
         # elastic fleet: worker count vs offered load + drain-back
         # (TFR_BENCH_ELASTIC=1)
         out.update(elastic_info)
+    if ckpt_info is not None:
+        # async vs sync checkpoint A/B + per-artifact commit p99
+        # (TFR_BENCH_CKPT=1)
+        out.update(ckpt_info)
     if scaling_info is not None:
         # workers->ex/s sweep (also appended to PARITY.md as the trend)
         out.update(scaling_info)

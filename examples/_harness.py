@@ -53,6 +53,26 @@ def resume_or_fresh(ds, ckpt_dir: str):
         return ds.batches(None), None
 
 
+def state_saver(ckpt_dir: str):
+    """(save_callback, saver) for ``run_train_loop``'s ``save=`` seam.
+
+    The callback snapshots the LIVE iterator position on the caller's
+    thread and hands the fsync-then-rename write to the background commit
+    thread (checkpoint.AsyncStateSaver), so the ``ckpt`` step phase
+    measures microseconds instead of disk latency. ``TFR_CKPT_MODE=sync``
+    keeps the write inline — the measurement twin the bench/verify
+    throttle legs compare against. Callers must ``saver.close()`` in a
+    ``finally`` so the last commit drains (and any commit failure
+    surfaces) before the process exits."""
+    sync = os.environ.get("TFR_CKPT_MODE", "async") == "sync"
+    saver = checkpoint.AsyncStateSaver(ckpt_dir, sync=sync)
+
+    def save(step, live_it, _state):
+        saver.save(live_it, step=step)
+
+    return save, saver
+
+
 def stage_throughput() -> dict:
     """records/sec per pipeline stage. Gauges share the snapshot namespace
     with a distinct {"gauge": v} shape, and pure event counters ride the

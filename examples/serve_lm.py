@@ -79,7 +79,7 @@ def serve(stream: "lm.LMStream", requests) -> dict:
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--ckpt-dir", default="/tmp/tpu_tfrecord_lm/ckpt",
-                    help="train_lm's checkpoint dir (lm_state.npz)")
+                    help="train_lm's checkpoint dir (gen-*/ generations)")
     ap.add_argument("--pipe", type=int, default=2, metavar="S",
                     help="pipeline stages (devices)")
     ap.add_argument("--virtual", type=int, default=2, metavar="V",
@@ -100,17 +100,19 @@ def main() -> None:
         ap.error(f"--pipe {args.pipe} exceeds {n_dev} devices")
     mesh = create_mesh({"pipe": args.pipe}, jax.devices()[: args.pipe])
 
-    # the trainer's checkpoint: params + opt state in one atomic npz; the
-    # serving path wants only the params half of the (params, opt) tuple
+    # the trainer's checkpoint: params + opt state from the newest
+    # COMPLETE generation (manifest-last layout); the serving path wants
+    # only the params half of the (params, opt) tuple
     template = lm.init_params(jax.random.key(0), cfg)
-    ck = LMCheckpoint(os.path.join(args.ckpt_dir, "lm_state.npz"))
+    ck = LMCheckpoint(args.ckpt_dir)
     import optax
 
     tx = optax.adam(3e-3)
     step, (params, _opt), _payload = ck.load((template, tx.init(template)))
+    ck.close()
     if step is None:
-        print(f"no checkpoint at {ck.path} — run train_lm first",
-              file=sys.stderr)
+        print(f"no complete checkpoint generation in {args.ckpt_dir} — "
+              f"run train_lm first", file=sys.stderr)
         sys.exit(1)
     params = jax.tree.map(np.asarray, params)
     print(f"serving checkpoint step {step} on pipe={args.pipe} "

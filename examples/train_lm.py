@@ -52,6 +52,7 @@ import optax
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import _harness
 
+from tpu_tfrecord import checkpoint
 from tpu_tfrecord.io.dataset import IteratorState, TFRecordDataset
 from tpu_tfrecord.io.writer import DatasetWriter
 from tpu_tfrecord.models import lm
@@ -117,41 +118,45 @@ def pick_mesh(kind: str, virtual: int = 1):
 
 
 class LMCheckpoint:
-    """Params + optimizer + input position + packer carry, ONE atomic npz.
+    """Params + optimizer + input position + packer carry, saved together.
 
-    A kill between two files would pair step-N params with a stale input
-    position (the skew TrainCheckpointer exists to prevent); one
-    os.replace removes the window entirely. The pytree structure is
-    rebuilt from the caller's live template, so only leaves are stored.
-    TrainCheckpointer (tpu_tfrecord.checkpoint) is the maintained orbax
-    path for real jobs; this example deliberately stays numpy+stdlib so
-    it runs where the optional orbax package is absent.
+    Now the async npz-shard twin (ISSUE 16): a thin wrapper over
+    ``checkpoint.AsyncCheckpointer``, so the caller's thread only pays
+    for the device snapshot while the stage+fsync+rename commit and the
+    manifest-last generation layout run on the background commit thread.
+    A kill -9 at any point resumes from the newest COMPLETE generation —
+    the same pairing guarantee the old single-file ``os.replace`` gave,
+    plus durability (fsync) and an off-step-path disk. ``sync=True`` is
+    the measurement twin: identical bytes, commit inline on the caller's
+    thread (what the bench A/B and verify.sh throttle legs compare).
+    Still numpy+stdlib on the persistence side — orbax stays optional.
     """
 
-    def __init__(self, path: str):
-        self.path = path
+    def __init__(self, directory: str, *, sync: bool = False):
+        self.directory = directory
+        self._ck = checkpoint.AsyncCheckpointer(
+            directory, keep=2, process_index=0, process_count=1, sync=sync,
+        )
 
     def save(self, step: int, state, payload: dict) -> None:
-        leaves, _ = jax.tree.flatten(state)
-        arrays = {
-            f"leaf_{i}": np.asarray(a) for i, a in enumerate(leaves)
-        }
-        meta = json.dumps({"step": step, **payload}).encode()
-        tmp = f"{self.path}.tmp.{os.getpid()}.npz"
-        with open(tmp, "wb") as fh:
-            np.savez(fh, meta=np.frombuffer(meta, np.uint8), **arrays)
-        os.replace(tmp, self.path)
+        self._ck.save(step, state, payload)
 
     def load(self, template):
         """(step, state, payload) or (None, template, None)."""
-        if not os.path.exists(self.path):
-            return None, template, None
-        with np.load(self.path) as z:
-            meta = json.loads(z["meta"].tobytes().decode())
-            leaves = [z[f"leaf_{i}"] for i in range(len(z.files) - 1)]
-        _, treedef = jax.tree.flatten(template)
-        state = jax.tree.unflatten(treedef, leaves)
-        return meta["step"], state, meta
+        return self._ck.restore(template)
+
+    def latest_step(self):
+        return self._ck.latest_step()
+
+    def clear(self) -> None:
+        """Drop every generation (the epoch-budget-exhausted path)."""
+        self._ck.clear()
+
+    def wait(self) -> None:
+        self._ck.wait()
+
+    def close(self) -> None:
+        self._ck.close()
 
 
 def packed_stream(it, packer: TokenPacker, snaps: dict):
@@ -188,6 +193,13 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=64,
                     help="total train steps (absolute, incl. resumed)")
     ap.add_argument("--save-every", type=int, default=8)
+    ap.add_argument("--ckpt-mode", default=os.environ.get(
+                        "TFR_CKPT_MODE", "async"),
+                    choices=("async", "sync"),
+                    help="async (default): background commit, the train "
+                         "loop only pays for the device snapshot; sync: "
+                         "the measurement twin, commit inline on the "
+                         "step path (what made ckpt_bound verdicts)")
     ap.add_argument("--epochs", type=int, default=4)
     ap.add_argument("--digest-out", default=None,
                     help="write one {'step','digest','loss'} JSON line per "
@@ -235,7 +247,7 @@ def main() -> None:
     tx = optax.adam(3e-3)
     opt_state = tx.init(params)
     os.makedirs(args.ckpt_dir, exist_ok=True)
-    ck = LMCheckpoint(os.path.join(args.ckpt_dir, "lm_state.npz"))
+    ck = LMCheckpoint(args.ckpt_dir, sync=(args.ckpt_mode == "sync"))
     start_step, (params, opt_state), payload = ck.load((params, opt_state))
     if "pipe_axis" in axes:
         params = jax.device_put(
@@ -342,10 +354,11 @@ def main() -> None:
                 )
         if digest_fh is not None:
             digest_fh.close()
+        ck.wait()  # drain the in-flight commit before judging completion
         completed = args.steps and start_step + steps >= args.steps
-        if not completed and os.path.exists(ck.path):
+        if not completed:
             # the epoch budget is exhausted: next run starts a fresh pass
-            os.remove(ck.path)
+            ck.clear()
         if args.trace_out:
             from tpu_tfrecord import telemetry
 
@@ -356,6 +369,7 @@ def main() -> None:
             stages=True, phases=phases,
         )
     finally:
+        ck.close()  # drain the background commit thread
         # a clean exit lands the spool's `final: true` goodbye snapshot
         _harness.release_trainer_spool(spool)
 

@@ -38,7 +38,6 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import _harness
 
 import tpu_tfrecord.io as tfio
-from tpu_tfrecord import checkpoint
 from tpu_tfrecord.io.dataset import TFRecordDataset
 from tpu_tfrecord.models import long_doc
 from tpu_tfrecord.schema import (
@@ -167,17 +166,18 @@ def main() -> None:
     phases = _harness.StepPhases()
     t0 = time.perf_counter()
     it, _resume = _harness.resume_or_fresh(ds, ckpt_dir)
+    save_cb, saver = _harness.state_saver(ckpt_dir)
     try:
         with it:
             (params, opt_state), steps, duty = _harness.run_train_loop(
                 it, produce, step, (params, opt_state),
-                save=lambda s, live_it, _state: checkpoint.save_state(
-                    ckpt_dir, live_it, step=s
-                ),
+                save=save_cb,
                 phases=phases,
             )
+        saver.wait()  # drain the background commit before the summary
         _harness.finish(ckpt_dir, steps, BATCH, t0, duty, phases=phases)
     finally:
+        saver.close()
         _harness.release_trainer_spool(spool)
 
 

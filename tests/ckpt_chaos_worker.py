@@ -1,0 +1,143 @@
+"""Subprocess worker for the checkpoint kill -9 chaos matrix
+(tests/test_ckpt_chaos.py): run a deterministic train-shaped loop that
+checkpoints through the async snapshot/commit path, printing one line per
+step so the parent can correlate, and — when the chaos seam is armed via
+``TFR_CKPT_CHAOS_STAGE``/``TFR_CKPT_CHAOS_MARK`` — park at the requested
+commit stage so the parent can SIGKILL this process at exactly that
+point. Relaunched without the seam, the worker resumes from the newest
+COMPLETE generation and runs to the step budget; the parent compares its
+step/row digests against an uninterrupted reference run.
+
+Modes:
+  pytree  AsyncCheckpointer over a numpy pytree (the tentpole path)
+  lm      examples/train_lm.py's LMCheckpoint twin (same layout via its
+          wrapper — proves the consumer wiring, not just the class)
+  state   plain checkpoint.save_state + fsync (the O(1) input-state leg)
+
+The state evolution is a pure function of (step, previous state) and the
+per-step "row" digest is a pure function of the step, so a resumed run is
+byte-identical to the uninterrupted one iff restore returned a complete,
+uncorrupted generation.
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _digest(state: dict) -> str:
+    h = hashlib.sha256()
+    for k in sorted(state):
+        h.update(k.encode())
+        h.update(np.ascontiguousarray(state[k]).tobytes())
+    return h.hexdigest()[:16]
+
+
+def _row_digest(step: int) -> str:
+    # the "input rows" consumed at this step, derived only from the step
+    rng = np.random.default_rng(step)
+    return hashlib.sha256(rng.integers(0, 256, 32).tobytes()).hexdigest()[:16]
+
+
+def _update(state: dict, step: int) -> dict:
+    # seeded per (step, key-rank) so the result is independent of dict
+    # iteration order (tree.unflatten rebuilds dicts in sorted-key order)
+    return {
+        k: v * 0.9
+        + np.random.default_rng([step, i]).standard_normal(v.shape)
+        for i, (k, v) in enumerate(sorted(state.items()))
+    }
+
+
+def _init_state() -> dict:
+    return {
+        "w": np.arange(96, dtype=np.float64).reshape(8, 12),
+        "b": np.zeros(12, dtype=np.float64),
+    }
+
+
+def run_model(mode: str, directory: str, steps: int, save_every: int) -> int:
+    from tpu_tfrecord.checkpoint import AsyncCheckpointer
+
+    if mode == "pytree":
+        ck = AsyncCheckpointer(
+            directory, keep=2, process_index=0, process_count=1
+        )
+    else:  # the train_lm consumer twin
+        sys.path.insert(
+            0,
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "examples",
+            ),
+        )
+        from train_lm import LMCheckpoint
+
+        ck = LMCheckpoint(directory)
+
+    template = _init_state()
+    start, state, payload = (
+        ck.restore(template) if mode == "pytree" else ck.load(template)
+    )
+    if start is None:
+        start = 0
+        state = template
+    state = {k: np.asarray(v) for k, v in state.items()}
+    print(f"resumed {start}", flush=True)
+    try:
+        for step in range(start + 1, steps + 1):
+            state = _update(state, step)
+            print(
+                f"step {step} state={_digest(state)} rows={_row_digest(step)}",
+                flush=True,
+            )
+            if step % save_every == 0:
+                ck.save(step, state, {"rows": _row_digest(step)})
+        ck.wait()
+        print(f"final {steps} {_digest(state)}", flush=True)
+    finally:
+        ck.close()
+    return 0
+
+
+def run_state(directory: str, steps: int, save_every: int) -> int:
+    from tpu_tfrecord.checkpoint import load_state, save_state
+    from tpu_tfrecord.io.dataset import IteratorState
+
+    resume = load_state(directory)
+    start = resume.shard_cursor if resume is not None else 0
+    print(f"resumed {start}", flush=True)
+    for step in range(start + 1, steps + 1):
+        print(f"step {step} rows={_row_digest(step)}", flush=True)
+        if step % save_every == 0:
+            save_state(
+                directory,
+                IteratorState(
+                    epoch=0, shard_cursor=step, record_offset=step * 7
+                ),
+                step=step,
+            )
+    print(f"final {steps} {_row_digest(steps)}", flush=True)
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("mode", choices=("pytree", "lm", "state"))
+    ap.add_argument("directory")
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--save-every", type=int, default=4)
+    args = ap.parse_args()
+    if args.mode == "state":
+        return run_state(args.directory, args.steps, args.save_every)
+    return run_model(args.mode, args.directory, args.steps, args.save_every)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

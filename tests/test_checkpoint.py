@@ -187,3 +187,196 @@ def test_version_check(tmp_path):
         json.dump({"version": 999, "state": {}}, fh)
     with pytest.raises(ValueError, match="version"):
         checkpoint.load_state(str(tmp_path), process_index=0)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 16: async snapshot/commit checkpointing
+# ---------------------------------------------------------------------------
+
+
+def _tree():
+    import numpy as np
+
+    return {
+        "w": np.arange(24, dtype=np.float64).reshape(4, 6),
+        "b": np.full(6, 3.5),
+    }
+
+
+class TestDurableWrite:
+    def test_writes_bytes_atomically(self, tmp_path):
+        p = str(tmp_path / "out.json")
+        checkpoint.durable_write(p, b'{"ok": 1}')
+        assert open(p, "rb").read() == b'{"ok": 1}'
+        assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
+
+    def test_failure_cleans_stage_file(self, tmp_path):
+        p = str(tmp_path / "out.bin")
+
+        def boom(fh):
+            raise RuntimeError("disk says no")
+
+        with pytest.raises(RuntimeError):
+            checkpoint.durable_write(p, write_fn=boom)
+        assert not os.path.exists(p)
+        assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
+
+    def test_torn_state_file_raises_named_error(self, tmp_path):
+        path = checkpoint.state_path(str(tmp_path), 0)
+        with open(path, "w") as fh:  # graftlint: allow(atomic-write: test constructs a deliberately torn file)
+            fh.write('{"version": 1, "sta')  # a torn tail
+        with pytest.raises(checkpoint.TornStateError, match="torn"):
+            checkpoint.load_state(str(tmp_path), process_index=0)
+
+
+class TestAsyncCheckpointer:
+    def test_round_trip_bitwise(self, tmp_path):
+        import numpy as np
+
+        state = _tree()
+        with checkpoint.AsyncCheckpointer(
+            str(tmp_path), process_index=0, process_count=1
+        ) as ck:
+            ck.save(8, state, {"rows": "abc"})
+            ck.wait()
+            step, restored, payload = ck.restore(_tree())
+        assert step == 8 and payload == {"rows": "abc"}
+        for k in state:
+            assert np.array_equal(state[k], restored[k])
+            assert state[k].dtype == restored[k].dtype
+
+    def test_sync_twin_same_bytes(self, tmp_path):
+        """sync=True must produce the identical generation layout/bytes —
+        it is the measurement twin, not a different format."""
+        a, s = str(tmp_path / "a"), str(tmp_path / "s")
+        with checkpoint.AsyncCheckpointer(
+            a, process_index=0, process_count=1
+        ) as ck:
+            ck.save(4, _tree(), {"x": 1})
+            ck.wait()
+        with checkpoint.AsyncCheckpointer(
+            s, process_index=0, process_count=1, sync=True
+        ) as ck:
+            ck.save(4, _tree(), {"x": 1})
+        rel = os.path.join("gen-00000004", "shard-00000.npz")
+        assert (
+            open(os.path.join(a, rel), "rb").read()
+            == open(os.path.join(s, rel), "rb").read()
+        )
+        assert sorted(os.listdir(os.path.join(a, "gen-00000004"))) == sorted(
+            os.listdir(os.path.join(s, "gen-00000004"))
+        )
+
+    def test_backpressure_one_commit_in_flight(self, tmp_path):
+        """The next save() waits out the previous commit and the wait is
+        counted (ckpt.commit_wait), never silently dropped."""
+        from tpu_tfrecord.metrics import Metrics
+
+        m = Metrics()
+        ck = checkpoint.AsyncCheckpointer(
+            str(tmp_path), process_index=0, process_count=1,
+            commit_delay_s=0.2, metrics=m,
+        )
+        ck.save(1, _tree(), None)
+        ck.save(2, _tree(), None)  # must block ~0.2s on commit 1
+        ck.close()
+        snap = m.snapshot()
+        assert snap["ckpt.commit_wait"]["records"] == 1
+        assert snap["ckpt.commit_wait"]["seconds"] >= 0.15
+        assert snap["ckpt.commit"]["records"] == 2
+        assert snap["ckpt.inflight"] == {"gauge": 0.0}
+        assert snap["ckpt.bytes_written"]["records"] > 0
+
+    def test_retention_and_dead_generation_sweep(self, tmp_path):
+        """keep=2 retires old complete generations; a dead generation
+        (shards, no manifest — an interrupted commit) is swept too."""
+        d = str(tmp_path)
+        # fabricate a dead generation an earlier life left behind
+        dead = os.path.join(d, "gen-00000003")
+        os.makedirs(dead)
+        open(os.path.join(dead, "shard-00000.npz"), "wb").close()  # graftlint: allow(atomic-write: zero-byte test fixture)
+        from tpu_tfrecord.metrics import Metrics
+
+        m = Metrics()
+        with checkpoint.AsyncCheckpointer(
+            d, keep=2, process_index=0, process_count=1, metrics=m
+        ) as ck:
+            for step in (4, 8, 12):
+                ck.save(step, _tree(), None)
+            ck.wait()
+        gens = sorted(n for n in os.listdir(d) if n.startswith("gen-"))
+        assert gens == ["gen-00000008", "gen-00000012"]
+        assert m.snapshot()["ckpt.generations_swept"]["records"] == 2
+
+    def test_commit_failure_surfaces_on_next_save(self, tmp_path, monkeypatch):
+        ck = checkpoint.AsyncCheckpointer(
+            str(tmp_path), process_index=0, process_count=1
+        )
+        monkeypatch.setattr(
+            checkpoint, "durable_write",
+            lambda *a, **k: (_ for _ in ()).throw(OSError("disk full")),
+        )
+        ck.save(1, _tree(), None)
+        with pytest.raises(checkpoint.CheckpointCommitError, match="disk full"):
+            ck.save(2, _tree(), None)
+
+    def test_torn_manifest_falls_back_a_generation(self, tmp_path):
+        with checkpoint.AsyncCheckpointer(
+            str(tmp_path), process_index=0, process_count=1
+        ) as ck:
+            ck.save(4, _tree(), {"gen": 4})
+            ck.save(8, _tree(), {"gen": 8})
+            ck.wait()
+            # tear generation 8's manifest the way a crash mid-write would
+            m8 = os.path.join(str(tmp_path), "gen-00000008", ck.MANIFEST)
+            with open(m8, "w") as fh:  # graftlint: allow(atomic-write: test constructs a deliberately torn file)
+                fh.write('{"version": 1, "sha')
+            step, _, payload = ck.restore(_tree())
+        assert step == 4 and payload == {"gen": 4}
+
+    def test_missing_shard_is_incomplete(self, tmp_path):
+        with checkpoint.AsyncCheckpointer(
+            str(tmp_path), process_index=0, process_count=1
+        ) as ck:
+            ck.save(4, _tree(), None)
+            ck.wait()
+            os.remove(
+                os.path.join(str(tmp_path), "gen-00000004", "shard-00000.npz")
+            )
+            assert ck.latest_step() is None
+
+    def test_clear_removes_all_generations(self, tmp_path):
+        with checkpoint.AsyncCheckpointer(
+            str(tmp_path), process_index=0, process_count=1
+        ) as ck:
+            ck.save(4, _tree(), None)
+            ck.clear()
+            assert ck.latest_step() is None
+            assert not [
+                n for n in os.listdir(str(tmp_path)) if n.startswith("gen-")
+            ]
+
+
+class TestAsyncStateSaver:
+    def test_same_file_same_bytes_as_save_state(self, tmp_path):
+        """The async saver is a twin, not a fork: identical path and
+        bytes to the inline save_state."""
+        st = IteratorState(epoch=1, shard_cursor=3, record_offset=70)
+        a, b = str(tmp_path / "a"), str(tmp_path / "b")
+        checkpoint.save_state(a, st, step=7, process_index=0)
+        with checkpoint.AsyncStateSaver(b, process_index=0) as saver:
+            saver.save(st, step=7)
+            saver.wait()
+        pa = checkpoint.state_path(a, 0)
+        pb = checkpoint.state_path(b, 0)
+        assert os.path.basename(pa) == os.path.basename(pb)
+        assert open(pa, "rb").read() == open(pb, "rb").read()
+
+    def test_round_trip_through_load_state(self, tmp_path):
+        st = IteratorState(epoch=2, shard_cursor=1, record_offset=9)
+        with checkpoint.AsyncStateSaver(
+            str(tmp_path), process_index=0
+        ) as saver:
+            saver.save(st, step=3)
+            saver.wait()
+        assert checkpoint.load_state(str(tmp_path), process_index=0) == st

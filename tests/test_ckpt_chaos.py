@@ -1,0 +1,151 @@
+"""Kill -9 chaos matrix for the async snapshot/commit checkpoint path
+(ISSUE 16). A subprocess worker (tests/ckpt_chaos_worker.py) runs a
+deterministic checkpointed loop; the chaos seam inside checkpoint.py
+parks the writer at an exact commit stage and touches a marker file, the
+parent lands SIGKILL there, and a clean relaunch must resume from the
+newest COMPLETE generation and finish byte-identical to an uninterrupted
+reference run.
+
+Matrix points (each on generation 2 of 3, so a complete generation 1
+exists to fall back to):
+  snapshot      kill while the caller's thread copies device state
+  shard         kill mid-shard-stage (tmp written, not yet renamed)
+  pre_manifest  kill after the shard landed, before the manifest
+  manifest      kill mid-manifest (manifest tmp fsynced, not renamed)
+plus the plain save_state+fsync leg (a torn state write must never
+surface: the previous complete state file survives the kill).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "ckpt_chaos_worker.py")
+STEPS, SAVE_EVERY = 12, 4  # generations at 4, 8, 12
+
+
+def _run(mode, directory, env=None, timeout=120):
+    full_env = {**os.environ, "JAX_PLATFORMS": "cpu", **(env or {})}
+    return subprocess.run(
+        [sys.executable, WORKER, mode, directory,
+         "--steps", str(STEPS), "--save-every", str(SAVE_EVERY)],
+        capture_output=True, text=True, env=full_env, timeout=timeout,
+    )
+
+
+def _launch_and_kill_at(mode, directory, stage, mark, skip=1):
+    """Arm the chaos seam, wait for the worker to park at ``stage``
+    (generation ``skip``+1), SIGKILL it there."""
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "TFR_CKPT_CHAOS_STAGE": stage,
+        "TFR_CKPT_CHAOS_MARK": mark,
+        "TFR_CKPT_CHAOS_SKIP": str(skip),
+    }
+    p = subprocess.Popen(
+        [sys.executable, WORKER, mode, directory,
+         "--steps", str(STEPS), "--save-every", str(SAVE_EVERY)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+    )
+    try:
+        deadline = time.time() + 120
+        while not os.path.exists(mark):
+            if p.poll() is not None:
+                out, err = p.communicate()
+                raise AssertionError(
+                    f"worker exited before parking at {stage}:\n{out}\n{err}"
+                )
+            if time.time() > deadline:
+                raise AssertionError(f"worker never parked at {stage}")
+            time.sleep(0.02)
+    finally:
+        if p.poll() is None:
+            os.kill(p.pid, signal.SIGKILL)
+        p.wait()
+
+
+def _digest_lines(stdout):
+    """{step: 'state=... rows=...'} from the worker's step lines, plus
+    the final digest."""
+    steps, final = {}, None
+    for line in stdout.splitlines():
+        if line.startswith("step "):
+            _, n, rest = line.split(" ", 2)
+            steps[int(n)] = rest
+        elif line.startswith("final "):
+            final = line.split(" ", 2)[2]
+    return steps, final
+
+
+@pytest.fixture(scope="module")
+def reference(tmp_path_factory):
+    """Uninterrupted run per mode: the byte-identity ground truth."""
+    out = {}
+    for mode in ("pytree", "lm", "state"):
+        d = str(tmp_path_factory.mktemp(f"ref-{mode}"))
+        p = _run(mode, d)
+        assert p.returncode == 0, p.stderr
+        out[mode] = _digest_lines(p.stdout)
+    return out
+
+
+@pytest.mark.parametrize(
+    "stage", ["snapshot", "shard", "pre_manifest", "manifest"]
+)
+def test_kill9_matrix_resumes_complete_generation(
+    stage, tmp_path, reference
+):
+    d = str(tmp_path / "ckpt")
+    mark = str(tmp_path / "mark")
+    _launch_and_kill_at("pytree", d, stage, mark)
+
+    # the kill interrupted generation 8's commit: generation 4 must be
+    # complete, generation 8 must NOT be restorable unless its manifest
+    # fully landed (it never does: the seam parks before the rename)
+    gens = sorted(n for n in os.listdir(d) if n.startswith("gen-"))
+    assert "gen-00000004" in gens
+    manifest8 = os.path.join(d, "gen-00000008", "MANIFEST.json")
+    assert not os.path.exists(manifest8), (
+        f"manifest landed despite kill at {stage}"
+    )
+
+    resumed = _run("pytree", d)
+    assert resumed.returncode == 0, resumed.stderr
+    assert "resumed 4" in resumed.stdout
+    steps, final = _digest_lines(resumed.stdout)
+    ref_steps, ref_final = reference["pytree"]
+    assert final == ref_final, "resumed end state diverged from reference"
+    for step, rest in steps.items():
+        assert rest == ref_steps[step], f"step {step} diverged on resume"
+
+
+def test_kill9_lm_twin_mid_commit(tmp_path, reference):
+    """The train_lm LMCheckpoint consumer wiring under the same kill."""
+    d = str(tmp_path / "ckpt")
+    mark = str(tmp_path / "mark")
+    _launch_and_kill_at("lm", d, "pre_manifest", mark)
+    resumed = _run("lm", d)
+    assert resumed.returncode == 0, resumed.stderr
+    assert "resumed 4" in resumed.stdout
+    _, final = _digest_lines(resumed.stdout)
+    assert final == reference["lm"][1]
+
+
+def test_kill9_state_leg_never_tears(tmp_path, reference):
+    """save_state+fsync: a kill parked between fsync and rename leaves
+    the PREVIOUS state file intact — load_state resumes from it, never
+    from a torn write."""
+    d = str(tmp_path / "ckpt")
+    mark = str(tmp_path / "mark")
+    _launch_and_kill_at("state", d, "state", mark)
+    resumed = _run("state", d)
+    assert resumed.returncode == 0, resumed.stderr
+    assert "resumed 4" in resumed.stdout
+    _, final = _digest_lines(resumed.stdout)
+    assert final == reference["state"][1]

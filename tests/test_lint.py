@@ -186,6 +186,51 @@ class TestAtomicWrite:
         })
         assert not by_rule(fs, "atomic-write")
 
+    def test_manifest_last_idiom_clean(self, tmp_path):
+        # the sharded-generation idiom (ISSUE 16): staged shard writes are
+        # compliant when the SAME function commits a manifest afterwards
+        # through one of the shared durable-write helpers
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                from tpu_tfrecord.checkpoint import durable_write
+                def commit_generation(gen, shards, manifest):
+                    for name, data in shards.items():
+                        with open(gen + "/" + name, "wb") as fh:
+                            fh.write(data)
+                    durable_write(gen + "/MANIFEST.json", manifest)
+            """,
+        })
+        assert not by_rule(fs, "atomic-write")
+
+    def test_manifest_first_writer_still_flagged(self, tmp_path):
+        # a manifest committed BEFORE the shard bytes covers nothing: a
+        # crash mid-shard leaves a manifest naming torn files
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                from tpu_tfrecord.checkpoint import durable_write
+                def commit_generation(gen, shards, manifest):
+                    durable_write(gen + "/MANIFEST.json", manifest)
+                    for name, data in shards.items():
+                        with open(gen + "/" + name, "wb") as fh:
+                            fh.write(data)
+            """,
+        })
+        assert len(by_rule(fs, "atomic-write")) == 1
+
+    def test_helper_method_call_also_commits(self, tmp_path):
+        # atomic_write_bytes reached as telemetry.atomic_write_bytes (an
+        # Attribute call) counts the same as the bare-name helper
+        fs = lint_snippets(tmp_path, {
+            "mod.py": """
+                from tpu_tfrecord import telemetry
+                def commit(gen, data, manifest):
+                    with open(gen + "/shard-0", "wb") as fh:
+                        fh.write(data)
+                    telemetry.atomic_write_bytes(gen + "/MANIFEST.json", manifest)
+            """,
+        })
+        assert not by_rule(fs, "atomic-write")
+
     def test_allow_pragma_suppresses_with_reason(self, tmp_path):
         fs = lint_snippets(tmp_path, {
             "mod.py": """

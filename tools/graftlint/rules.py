@@ -89,16 +89,24 @@ class AtomicWriteRule(Rule):
     resume) chokes on the stump. Compliant shapes recognized statically:
     the enclosing function also renames (stage-then-replace), or the path
     expression names a tmp/staging location (the stage file of such a
-    pattern)."""
+    pattern), or the enclosing function commits a MANIFEST afterwards via
+    one of the shared durable-write helpers (the manifest-last sharded
+    generation idiom: staged shard files are made visible-as-a-set by a
+    later ``checkpoint.durable_write``/``atomic_write_bytes`` of the
+    manifest, so readers only ever observe complete generations). The
+    helper call must come AFTER the staged write — a manifest committed
+    first covers nothing and stays flagged."""
 
     id = "atomic-write"
     hint = (
-        "write via telemetry.atomic_write_bytes, or stage to a tmp path "
-        "and os.replace into place"
+        "write via telemetry.atomic_write_bytes or checkpoint.durable_write, "
+        "stage to a tmp path and os.replace into place, or commit a "
+        "manifest LAST via one of those helpers"
     )
 
     _STAGED_PATH_MARKERS = ("tmp", "staging", "partial", "scratch")
     _RENAMES = {"replace", "rename", "renames"}
+    _COMMIT_HELPERS = {"atomic_write_bytes", "durable_write"}
 
     def visit(self, node: ast.AST, walker: Walker) -> None:
         if not isinstance(node, ast.Call):
@@ -123,6 +131,8 @@ class AtomicWriteRule(Rule):
         )
         if self._scope_renames(scope):
             return
+        if self._scope_commits_manifest_after(scope, node.lineno):
+            return
         self.emit(
             walker.ctx,
             node.lineno,
@@ -143,6 +153,24 @@ class AtomicWriteRule(Rule):
                     recv = _unparse(f.value)
                     if recv == "os" or "fs" in recv.lower():
                         return True
+        return False
+
+    def _scope_commits_manifest_after(self, scope: ast.AST, lineno: int) -> bool:
+        """The manifest-last idiom: the scope calls one of the shared
+        durable-write commit helpers AFTER this write (by line), so the
+        staged file only becomes load-bearing once the manifest lands
+        atomically. A helper call BEFORE the write is manifest-first —
+        it commits nothing about the bytes written later, so it must not
+        exempt them."""
+        for sub in ast.walk(scope):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None
+            )
+            if name in self._COMMIT_HELPERS and sub.lineno > lineno:
+                return True
         return False
 
 

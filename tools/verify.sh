@@ -690,7 +690,10 @@ res = subprocess.run(
     capture_output=True, text=True, timeout=600,
 )
 assert res.returncode == 0, (res.returncode, res.stdout[-2000:], res.stderr[-1000:])
-assert os.path.exists(os.path.join(ck, "lm_state.npz")), os.listdir(ck)
+# the async generation layout: newest COMPLETE generation carries the
+# manifest committed last
+assert os.path.exists(os.path.join(ck, "gen-00000008", "MANIFEST.json")), \
+    os.listdir(ck)
 
 srv = subprocess.run(
     [sys.executable, "examples/serve_lm.py", "--ckpt-dir", ck,
@@ -708,6 +711,73 @@ print("serving smoke OK:", json.dumps({
     "requests_per_s": rep["requests_per_s"],
     "latency_ms_p50": rep["latency_ms_p50"],
     "byte_identical": rep["byte_identical_to_batch"],
+}))
+PY
+
+echo "== async-ckpt smoke (seeded slow disk, SIGKILL mid-commit -> resume from complete generation, non-ckpt_bound) =="
+# ISSUE 16 end-to-end: train_lm under a seeded commit throttle (the
+# slow-disk fault). The kill leg SIGKILLs right after step 9 — the step-8
+# generation's background commit is mid-throttle, so only the step-4
+# generation is complete on disk. The resume leg must restore from a
+# COMPLETE generation (4, or 8 if the commit squeaked through), run to
+# the full step budget, and — because the commit runs off the step path —
+# its verdict line must NOT read ckpt_bound even with the throttle still
+# armed. `doctor train` on the resumed run's spool exits 0. The LM smoke
+# above already pins byte-identical digests across kill/resume at the
+# default (async) mode.
+env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python - <<'PY' || exit 1
+import json, os, re, signal, subprocess, sys, tempfile
+
+root = tempfile.mkdtemp(prefix="tfr_ackpt_smoke_")
+data, ck = os.path.join(root, "data"), os.path.join(root, "ckpt")
+spool = os.path.join(root, "spool")
+env = {**os.environ, "TFR_CKPT_COMMIT_THROTTLE_S": "0.5"}
+
+# kill leg: SIGKILL lands while generation 8's commit sleeps in the
+# throttle (the step lines keep flowing — the loop is not waiting on it)
+cmd = [sys.executable, "examples/train_lm.py", "--mesh", "dp",
+       "--steps", "16", "--save-every", "4", "--data-dir", data,
+       "--ckpt-dir", ck, "--digest-out", os.path.join(root, "k.jsonl")]
+p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                     stderr=subprocess.STDOUT, text=True, env=env)
+for line in p.stdout:
+    if line.startswith("lm_step") and \
+            json.loads(line.split(" ", 1)[1])["step"] >= 9:
+        os.kill(p.pid, signal.SIGKILL)
+        break
+p.wait()
+gens = sorted(n for n in os.listdir(ck) if n.startswith("gen-"))
+complete = [g for g in gens
+            if os.path.exists(os.path.join(ck, g, "MANIFEST.json"))]
+assert complete, (gens, "no complete generation survived the kill")
+
+# resume leg: lighter throttle (commit hides under 4 steps of compute),
+# must resume from a complete generation and finish all 16 steps with a
+# non-ckpt_bound verdict
+env["TFR_CKPT_COMMIT_THROTTLE_S"] = "0.05"
+res = subprocess.run(cmd + ["--spool", spool, "--spool-interval", "0.2"],
+                     capture_output=True, text=True, env=env, timeout=600)
+assert res.returncode == 0, (res.returncode, res.stdout[-2000:], res.stderr[-1000:])
+m = re.search(r"resumed at step (\d+)", res.stdout)
+assert m and int(m.group(1)) in (4, 8), res.stdout[-1500:]
+assert re.search(r"done: 16 steps", res.stdout), res.stdout[-1500:]
+v = re.search(r"verdict: (\w+)", res.stdout)
+assert v and v.group(1) != "ckpt_bound", res.stdout[-1500:]
+
+# doctor train on the resumed run's spool: exit 0 with a verdict
+doc = subprocess.run([sys.executable, "tools/tfrecord_doctor.py", "train",
+                      spool, "--stale-after", "3600"],
+                     capture_output=True, text=True)
+assert doc.returncode == 0, (doc.returncode, doc.stdout, doc.stderr)
+summary = [json.loads(l) for l in doc.stdout.splitlines()
+           if l.strip() and json.loads(l).get("event") == "train"][0]
+assert summary["verdict"] != "ckpt_bound", summary
+print("async-ckpt smoke OK:", json.dumps({
+    "resumed_at": int(m.group(1)),
+    "complete_generations_after_kill": complete,
+    "resume_verdict": v.group(1),
+    "doctor_verdict": summary["verdict"],
 }))
 PY
 

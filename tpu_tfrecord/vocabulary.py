@@ -115,6 +115,9 @@ COUNTERS: Dict[str, str] = {
     "elastic.verdict_errors": "fleet verdict reads that failed (not idle)",
     # -- training flight recorder
     "train.steps": "completed harness train steps",
+    # -- async checkpointing (snapshot/commit split)
+    "ckpt.bytes_written": "checkpoint bytes committed to disk",
+    "ckpt.generations_swept": "retired/dead checkpoint generations removed",
     # -- streamed serving (pipeline inference mode)
     "serve.requests": "microbatches served by the streaming pipeline",
 }
@@ -143,6 +146,9 @@ STAGES: Dict[str, str] = {
     "train.h2d": "train step host->device transfer",
     "train.compute": "train step device compute",
     "train.ckpt": "train step checkpoint writes",
+    "ckpt.snapshot": "checkpoint snapshot (caller-thread device_get + copy)",
+    "ckpt.commit": "checkpoint commit (background stage+fsync+rename)",
+    "ckpt.commit_wait": "save() blocked on the previous in-flight commit",
     # dimensionless in-jit model diagnostics (histograms of fractions —
     # telemetry.DIMENSIONLESS_HIST_PREFIXES keeps them out of ms renderers)
     "moe.dropped_fraction": "tokens dropped at expert capacity (fraction)",
@@ -166,6 +172,7 @@ GAUGES: Dict[str, str] = {
     "train.share.h2d": "windowed share of step wall in h2d",
     "train.share.compute": "windowed share of step wall in compute",
     "train.share.ckpt": "windowed share of step wall in checkpointing",
+    "ckpt.inflight": "background checkpoint commits in flight (0 or 1)",
     "moe.dropped_fraction": "latest per-step dropped-token fraction",
     "moe.gate_entropy": "latest per-step router gate entropy",
     "moe.expert_imbalance": "latest per-step expert imbalance",
