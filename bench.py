@@ -1130,6 +1130,157 @@ def _elastic_probe() -> dict:
         d.stop()
 
 
+def _lease_throughput_probe() -> dict:
+    """Aggregate lease throughput vs partition count K (ISSUE 17): the
+    scale half of killing the dispatcher SPOF. K journaled dispatcher
+    SUBPROCESSES (real process parallelism — the probe measures the
+    service tier, not this process's GIL), one registered worker each,
+    and a fixed pool of hammer threads driving route + shard_done pairs
+    over persistent sockets — each thread a distinct tenant routed by
+    the same ``PartitionMap`` consumers use, every pair two fsynced
+    journal appends (the mutation path as deployed). Reports ops/s at
+    K=1 and K=2 and whether aggregate throughput grew. Device-free:
+    runs in the pre-backend block."""
+    import subprocess
+    import tempfile
+    import threading
+
+    from tpu_tfrecord import service
+    from tpu_tfrecord import service_protocol as sp
+
+    seconds = float(os.environ.get("TFR_BENCH_LEASE_SECONDS", 2.0))
+    procs_n = int(os.environ.get("TFR_BENCH_LEASE_PROCS", 4))
+    threads_n = int(os.environ.get("TFR_BENCH_LEASE_THREADS", 8))
+    root = tempfile.mkdtemp(prefix="tfr_bench_lease_")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    pkg_parent = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = (
+        pkg_parent + os.pathsep + env["PYTHONPATH"]
+        if env.get("PYTHONPATH") else pkg_parent
+    )
+
+    # hammer CLIENTS are subprocesses too — client-side GIL must not be
+    # what one measures when asking whether the SERVICE tier scales.
+    # Each runs threads_n synchronous route+shard_done loops, one tenant
+    # per thread, routed by the same PartitionMap consumers use, and
+    # prints its completed-pair count.
+    hammer_src = """
+import json, sys, threading, time
+from tpu_tfrecord import service
+from tpu_tfrecord import service_protocol as sp
+
+spec, proc_i, threads_n, start_at, stop_at = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
+    float(sys.argv[4]), float(sys.argv[5]),
+)
+pmap = service.PartitionMap.parse(spec)
+counts = [0] * threads_n
+
+def hammer(ti):
+    tenant = f"bench-tenant-{proc_i}-{ti}"
+    addr = pmap.addrs(pmap.partition_for(tenant))[0]
+    s = sp.connect(addr, timeout=10.0)
+    try:
+        s.settimeout(10.0)
+        # sockets up, imports paid: wait for the fleet-wide start line so
+        # interpreter startup never dilutes the measured window
+        while time.time() < start_at:
+            time.sleep(0.005)
+        i = 0
+        while time.time() < stop_at:
+            path = f"/bench/{proc_i}/{ti}/shard-{i:06d}"
+            base = {"proto": service.PROTO_VERSION, "tenant": tenant,
+                    "job": tenant, "consumer": tenant, "path": path}
+            r = sp.request(s, addr, {"op": "route", "shard_index": i,
+                                     **base})
+            if r.get("ok"):
+                sp.request(s, addr, {"op": "shard_done",
+                                     "worker_id": r["worker_id"], **base})
+                counts[ti] += 1
+            i += 1
+    finally:
+        s.close()
+
+ths = [threading.Thread(target=hammer, args=(ti,))
+       for ti in range(threads_n)]
+for t in ths:
+    t.start()
+for t in ths:
+    t.join()
+print(json.dumps({"pairs": sum(counts)}), flush=True)
+"""
+
+    def run_k(k: int) -> float:
+        procs = []
+        addrs = []
+        try:
+            for i in range(k):
+                p = subprocess.Popen(
+                    [sys.executable, "-m", "tpu_tfrecord.service",
+                     "dispatcher", "--partition", str(i), "--journal",
+                     os.path.join(root, f"journal-k{k}-p{i}.json")],
+                    stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                    text=True, env=env,
+                )
+                procs.append(p)
+                ready = json.loads(p.stdout.readline())
+                addrs.append(ready["addr"])
+            spec = ",".join(addrs)
+            for a in addrs:
+                # one registered (never-fetched-from) worker per
+                # partition so routes have something to grant
+                s = sp.connect(a, timeout=5.0)
+                try:
+                    s.settimeout(5.0)
+                    sp.request(s, a, {"op": "register_worker",
+                                      "proto": service.PROTO_VERSION,
+                                      "worker_id": f"bench-{a}",
+                                      "addr": a, "pid": 0})
+                finally:
+                    s.close()
+            # start line 2s out: every child is connected and waiting
+            # before the window opens, so startup cost is outside it
+            start_at = time.time() + 2.0
+            stop_at = start_at + seconds
+            hammers = [
+                subprocess.Popen(
+                    [sys.executable, "-c", hammer_src, spec, str(pi),
+                     str(threads_n), str(start_at), str(stop_at)],
+                    stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                    text=True, env=env,
+                )
+                for pi in range(procs_n)
+            ]
+            pairs = 0
+            for h in hammers:
+                out, _ = h.communicate(timeout=seconds * 10 + 30)
+                pairs += json.loads(out)["pairs"]
+            return pairs / seconds if seconds > 0 else 0.0
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=5.0)
+                except Exception:  # noqa: BLE001 — shutdown safety net
+                    p.kill()
+
+    k1 = run_k(1)
+    k2 = run_k(2)
+    return {
+        "lease_throughput_vs_k": {
+            "client_procs": procs_n,
+            "threads_per_proc": threads_n,
+            "seconds": seconds,
+            "k1_ops_s": round(k1, 1),
+            "k2_ops_s": round(k2, 1),
+            "speedup": round(k2 / k1, 3) if k1 else None,
+            "grows": k2 > k1,
+        }
+    }
+
+
 def _decode_scaling_trend(data_dir, schema, hash_buckets, pack) -> dict:
     """Workers -> ex/s sweep, committed to PARITY.md every round (ROADMAP
     #1 / VERDICT #8): one round's scaling sample is an anecdote; the
@@ -1940,6 +2091,11 @@ def main() -> None:
         # elastic decode fleet: worker count tracks offered load, drains
         # on load removal (~16s, device-free) — ISSUE 12
         elastic_info = _elastic_probe()
+    lease_info = None
+    if os.environ.get("TFR_BENCH_LEASE", "1") != "0":
+        # partitioned dispatchers: aggregate lease throughput K=1 vs K=2
+        # (~6s, device-free) — ISSUE 17
+        lease_info = _lease_throughput_probe()
     ckpt_info = None
     if os.environ.get("TFR_BENCH_CKPT", "1") != "0":
         # async vs sync checkpoint A/B under a seeded commit throttle +
@@ -1989,7 +2145,7 @@ def main() -> None:
             for extra in (cold_info, remote_info, remote_http_info,
                           stall_info, warm_info, telemetry_info,
                           seq_host_info, autotune_info, service_info,
-                          elastic_info, ckpt_info, scaling_info,
+                          elastic_info, lease_info, ckpt_info, scaling_info,
                           model_parallel_info):
                 if extra is not None:
                     out.update(extra)
@@ -2006,7 +2162,7 @@ def main() -> None:
         for extra in (cold_info, remote_info, remote_http_info,
                       stall_info, warm_info, telemetry_info,
                       seq_host_info, autotune_info, service_info,
-                      elastic_info, ckpt_info, scaling_info,
+                      elastic_info, lease_info, ckpt_info, scaling_info,
                       model_parallel_info):
             if extra is not None:
                 err.update(extra)
@@ -2405,6 +2561,10 @@ def main() -> None:
         # elastic fleet: worker count vs offered load + drain-back
         # (TFR_BENCH_ELASTIC=1)
         out.update(elastic_info)
+    if lease_info is not None:
+        # partitioned-dispatcher lease throughput K=1 vs K=2
+        # (TFR_BENCH_LEASE=1)
+        out.update(lease_info)
     if ckpt_info is not None:
         # async vs sync checkpoint A/B + per-artifact commit p99
         # (TFR_BENCH_CKPT=1)

@@ -505,11 +505,27 @@ class TestDispatcher:
             d2.stop()
 
     def test_unreadable_journal_is_loud(self, tmp_path):
+        # an unreadable FILE (a directory at the journal path reads as
+        # EISDIR) is loud — silently starting fresh would orphan every
+        # lease the real journal records
+        journal = str(tmp_path / "journal.json")
+        os.mkdir(journal)
+        with pytest.raises(RuntimeError, match="unreadable dispatcher journal"):
+            service.ServiceDispatcher(journal=journal)
+
+    def test_torn_journal_content_replays_consistent_prefix(self, tmp_path):
+        # torn CONTENT is not an error since the HA PR: a crash mid-append
+        # legitimately leaves a partial tail, and replay folds the newest
+        # consistent prefix (here: nothing) instead of refusing to start
         journal = str(tmp_path / "journal.json")
         with open(journal, "w") as fh:
             fh.write("{torn")
-        with pytest.raises(RuntimeError, match="unreadable dispatcher journal"):
-            service.ServiceDispatcher(journal=journal)
+        d = service.ServiceDispatcher(journal=journal)
+        try:
+            assert d.status()["workers"] == []
+            assert d.accepting
+        finally:
+            d.stop()
 
     def test_shard_done_idempotent(self):
         d = service.ServiceDispatcher(lease_ttl_s=5.0)

@@ -95,13 +95,23 @@ class AtomicWriteRule(Rule):
     later ``checkpoint.durable_write``/``atomic_write_bytes`` of the
     manifest, so readers only ever observe complete generations). The
     helper call must come AFTER the staged write — a manifest committed
-    first covers nothing and stays flagged."""
+    first covers nothing and stays flagged.
+
+    Append-ONLY opens (``"a"``/``"ab"`` with no ``w``/``x``) get their
+    own idiom: ``checkpoint.durable_append``'s fsync-before-return shape.
+    An append never truncates — a crash tears at most the unfsynced
+    tail, which a newest-consistent-prefix reader (the dispatcher
+    journal replay) absorbs by design — so an append-only open whose
+    enclosing scope also calls ``os.fsync`` is compliant. An append
+    WITHOUT the fsync still tears silently across a host crash and
+    stays flagged."""
 
     id = "atomic-write"
     hint = (
         "write via telemetry.atomic_write_bytes or checkpoint.durable_write, "
-        "stage to a tmp path and os.replace into place, or commit a "
-        "manifest LAST via one of those helpers"
+        "stage to a tmp path and os.replace into place, commit a "
+        "manifest LAST via one of those helpers, or (append-only logs) "
+        "go through checkpoint.durable_append's fsync-before-return shape"
     )
 
     _STAGED_PATH_MARKERS = ("tmp", "staging", "partial", "scratch")
@@ -129,6 +139,9 @@ class AtomicWriteRule(Rule):
         scope: ast.AST = (
             walker.func_stack[-1] if walker.func_stack else walker.ctx.tree
         )
+        append_only = "a" in mode.value and not ({"w", "x"} & set(mode.value))
+        if append_only and self._scope_fsyncs(scope):
+            return  # the durable-append idiom (fsync before return)
         if self._scope_renames(scope):
             return
         if self._scope_commits_manifest_after(scope, node.lineno):
@@ -140,6 +153,22 @@ class AtomicWriteRule(Rule):
             f"{mode.value!r}) in {walker.qualname}",
             detail=f"open@{walker.qualname}:{_unparse(node.args[0])}",
         )
+
+    @staticmethod
+    def _scope_fsyncs(scope: ast.AST) -> bool:
+        """An ``os.fsync(...)`` anywhere in the scope — paired with an
+        append-only open this is the durable-append shape (the bytes are
+        on the platter before the writer reports success)."""
+        for sub in ast.walk(scope):
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr == "fsync"
+                    and _unparse(f.value) == "os"
+                ):
+                    return True
+        return False
 
     def _scope_renames(self, scope: ast.AST) -> bool:
         """A rename call that plausibly lands a staged file: ``os.replace``/

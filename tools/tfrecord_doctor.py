@@ -686,23 +686,37 @@ def _fleet_report(args, emit) -> int:
 
 
 def serve_status_main(argv: List[str]) -> int:
-    """The ``serve-status`` subcommand: one status round trip to a data
-    service dispatcher (tpu_tfrecord.service) — one ``worker`` line per
+    """The ``serve-status`` subcommand: one status round trip per data
+    service partition (tpu_tfrecord.service) — ``dispatcher`` is a single
+    host:port or a full partition-map spec (``h:p1|h:p2,h:p3`` /
+    ``@map.json``), and each partition is asked preferring the acting
+    primary (a member answering as a warm standby still counts: the
+    partition is alive). Per partition: one ``worker`` line per
     registered worker (liveness, draining flag, current leases, shards
     done, heartbeat age; the fleet doctor's per-proc rendering
     vocabulary), one ``tenant`` line per decode fingerprint (consumers /
     jobs / leases / warm-cache hit ratio — the multi-tenant sharing
-    picture), a ``scaler`` line when an elastic FleetScaler is attached
-    (current workers, last decision + reason, drain list), and one
-    ``service`` summary line. Exit 0 = report produced (dead workers are
-    a finding, not a failure); 2 = dispatcher unreachable or not a
+    picture), and one ``service`` summary line carrying the HA fields
+    (role, generation, failed_over, demoted). One ``scaler`` line when an
+    elastic FleetScaler is attached (the federated scaler publishes the
+    same block to every partition, so it is emitted once), and — under a
+    multi-partition map — one federated ``ha`` summary (partitions
+    answered, acting primaries, failovers observed, distinct workers
+    across partitions). Exit 0 = every partition answered by someone
+    (dead workers and a completed failover are findings, not failures);
+    2 = some partition fully unreachable or a member is not a
     dispatcher."""
     ap = argparse.ArgumentParser(
         prog="tfrecord_doctor serve-status",
-        description="Data-service doctor: ask the dispatcher who is "
+        description="Data-service doctor: ask the dispatcher(s) who is "
         "serving what",
     )
-    ap.add_argument("dispatcher", help="dispatcher host:port")
+    ap.add_argument(
+        "dispatcher",
+        help="dispatcher host:port, or a partition-map spec "
+        "('h:p1|h:p2,h:p3' — comma-separated partitions, each "
+        "primary|standby — or '@map.json')",
+    )
     ap.add_argument(
         "--timeout", type=float, default=5.0, metavar="SECONDS",
         help="connect/request deadline (default 5s)",
@@ -721,19 +735,82 @@ def _serve_status_report(args, emit) -> int:
     from tpu_tfrecord import service
 
     try:
-        status = service.fetch_status(args.dispatcher, timeout=args.timeout)
+        pmap = service.PartitionMap.parse(args.dispatcher)
     except (OSError, ValueError) as e:
         emit({"event": "error", "path": args.dispatcher, "error": str(e)})
         return 2
-    if not status.get("ok") or status.get("role") != "dispatcher":
+
+    ok = True
+    scaler_emitted = False
+    all_workers: set = set()
+    acting, failovers, generations = 0, 0, []
+    for part in range(pmap.k):
+        status, addr_used, best, errors = None, None, None, []
+        for addr in pmap.addrs(part):
+            try:
+                st = service.fetch_status(addr, timeout=args.timeout)
+            except (OSError, ValueError) as e:
+                errors.append(f"{addr}: {e}")
+                continue
+            if not st.get("ok") or st.get("role") not in (
+                "dispatcher", "standby"
+            ):
+                errors.append(
+                    f"{addr}: "
+                    f"{st.get('error') or f'not a dispatcher: {st!r}'}"
+                )
+                continue
+            if st.get("role") == "dispatcher" and st.get("accepting", True):
+                status, addr_used = st, addr
+                break  # the acting primary answered — done here
+            if best is None:
+                # a standby (or demoted primary) answered: the partition
+                # is alive, but keep scanning for the acting primary
+                best = (st, addr)
+        if status is None and best is not None:
+            status, addr_used = best
+        if status is None:
+            ok = False
+            emit({
+                "event": "error", "partition": part,
+                "path": "|".join(pmap.addrs(part)),
+                "error": "; ".join(errors) or "unreachable",
+            })
+            continue
+        if status.get("accepting", True) and status.get("role") == "dispatcher":
+            acting += 1
+        if status.get("failed_over"):
+            failovers += 1
+        generations.append(status.get("generation", 0))
+        for w in status.get("workers", []):
+            all_workers.add(w["worker_id"])
+        scaler_emitted = _emit_partition_status(
+            emit, part, addr_used, status,
+            emit_scaler=not scaler_emitted,
+        ) or scaler_emitted
+    if pmap.k > 1:
         emit({
-            "event": "error", "path": args.dispatcher,
-            "error": status.get("error", f"not a dispatcher: {status!r}"),
+            "event": "ha",
+            "path": args.dispatcher,
+            "partitions": pmap.k,
+            "answered": len(generations),
+            "acting_primaries": acting,
+            "failed_over": failovers,
+            "generations": generations,
+            "workers": len(all_workers),
         })
-        return 2
+    return 0 if ok else 2
+
+
+def _emit_partition_status(emit, part, addr_used, status,
+                           emit_scaler=True) -> bool:
+    """One partition's worker/tenant/scaler/service lines. Returns True
+    when a scaler line was emitted (a federated scaler publishes the same
+    block everywhere, so the caller emits it at most once)."""
     for w in status.get("workers", []):
         emit({
             "event": "worker",
+            "partition": part,
             "worker_id": w["worker_id"],
             "addr": w["addr"],
             "pid": w["pid"],
@@ -749,6 +826,7 @@ def _serve_status_report(args, emit) -> int:
         completions = info.get("completions", 0)
         emit({
             "event": "tenant",
+            "partition": part,
             "tenant": t,
             "consumers": info.get("consumers", 0),
             "jobs": info.get("jobs", 0),
@@ -762,7 +840,9 @@ def _serve_status_report(args, emit) -> int:
             ),
         })
     scaler = status.get("scaler")
-    if scaler is not None:
+    scaler_shown = False
+    if scaler is not None and emit_scaler:
+        scaler_shown = True
         emit({
             "event": "scaler",
             "workers": scaler.get("workers"),
@@ -777,7 +857,13 @@ def _serve_status_report(args, emit) -> int:
         })
     emit({
         "event": "service",
-        "path": args.dispatcher,
+        "partition": part,
+        "path": addr_used,
+        "role": status.get("role"),
+        "generation": status.get("generation", 0),
+        "accepting": status.get("accepting", True),
+        "failed_over": status.get("failed_over", False),
+        "demoted": status.get("demoted", False),
         "workers": len(status.get("workers", [])),
         "alive": status.get("alive", 0),
         "draining": status.get("draining", []),
@@ -793,7 +879,7 @@ def _serve_status_report(args, emit) -> int:
         "lease_reassignments": status.get("lease_reassignments", 0),
         "trace_id": status.get("trace_id"),
     })
-    return 0
+    return scaler_shown
 
 
 def train_main(argv: List[str]) -> int:

@@ -849,6 +849,137 @@ print("trainer-telemetry smoke OK:", json.dumps({
 }))
 PY
 
+echo "== HA smoke (2 partitions + warm standby, primary SIGKILL mid-read -> standby serves, byte-identical) =="
+# The HA control plane end-to-end, production-shaped: two dispatcher
+# PARTITION primaries plus one warm standby, all real subprocesses sharing
+# a journal file, two decode workers registered with every partition. The
+# primary of the partition that OWNS the dataset's tenant is SIGKILLed
+# mid-read; the standby must detect death by ping loss, promote with a
+# bumped generation, take over the dead primary's address, and finish the
+# epoch byte-identical to a direct local read with ZERO local-read
+# fallbacks. `serve-status` over the partition map must exit 0 and report
+# the failover — so the failover path can't rot.
+env JAX_PLATFORMS=cpu python - <<'PY' || exit 1
+import json, os, signal, subprocess, sys, tempfile, time
+
+import tpu_tfrecord.io as tfio
+from tpu_tfrecord import service
+from tpu_tfrecord.columnar import batch_to_rows
+from tpu_tfrecord.io.dataset import TFRecordDataset
+from tpu_tfrecord.metrics import METRICS
+from tpu_tfrecord.schema import LongType, StringType, StructField, StructType
+
+schema = StructType([StructField("id", LongType(), nullable=False),
+                     StructField("s", StringType())])
+base = tempfile.mkdtemp(prefix="tfr_ha_smoke_")
+out = os.path.join(base, "ds")
+for s in range(6):
+    tfio.write([[i, f"s{i}"] for i in range(s * 30, (s + 1) * 30)],
+               schema, out, mode="append" if s else "overwrite")
+
+def epoch_rows(**kw):
+    ds = TFRecordDataset(out, batch_size=8, schema=schema,
+                         drop_remainder=False, **kw)
+    rows = []
+    with ds.batches() as it:
+        for b in it:
+            rows.extend(batch_to_rows(b, ds.schema))
+            yield_hook(rows, ds)
+    return rows
+
+yield_hook = lambda rows, ds: None
+local = epoch_rows()
+
+# which of the two partitions will own this dataset's tenant? (rendezvous
+# hashing is over partition INDICES, so the answer predates the addresses)
+tenant = service.tenant_digest(
+    TFRecordDataset(out, batch_size=8, schema=schema))
+owner = service.PartitionMap.parse("h:1,h:2").partition_for(tenant)
+
+env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+procs = []
+import atexit
+def _reap():
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+atexit.register(_reap)
+
+def spawn(*argv):
+    p = subprocess.Popen([sys.executable, "-m", "tpu_tfrecord.service", *argv],
+                         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                         text=True, env=env)
+    procs.append(p)
+    return p, json.loads(p.stdout.readline())
+
+journals = [os.path.join(base, f"journal-{i}.jsonl") for i in range(2)]
+prim, addrs = [], []
+for i in range(2):
+    p, ready = spawn("dispatcher", "--journal", journals[i],
+                     "--partition", str(i), "--lease-ttl-s", "10")
+    prim.append(p)
+    addrs.append(ready["addr"])
+standby_p, standby_ready = spawn(
+    "dispatcher", "--journal", journals[owner],
+    "--standby-of", addrs[owner], "--partition", str(owner),
+    "--lease-ttl-s", "10", "--ping-interval", "0.2",
+    "--takeover-misses", "3")
+groups = list(addrs)
+groups[owner] = f"{addrs[owner]}|{standby_ready['addr']}"
+spec = ",".join(groups)
+
+for _ in range(2):
+    spawn("worker", "--dispatcher", spec)
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:
+    counts = [len(service.fetch_status(a).get("workers", [])) for a in addrs]
+    if counts == [2, 2]:
+        break
+    time.sleep(0.05)
+assert counts == [2, 2], f"workers never registered everywhere: {counts}"
+
+killed = []
+def yield_hook(rows, ds):
+    if killed or len(rows) < 40:
+        return
+    os.kill(prim[owner].pid, signal.SIGKILL)  # mid-read, no warning
+    prim[owner].wait()
+    killed.append(owner)
+
+METRICS.reset()
+got = epoch_rows(service=spec, service_deadline_ms=10000)
+assert killed, "epoch ended before the kill hook fired"
+assert got == local, "post-failover epoch rows differ from direct local read"
+assert METRICS.counter("service.fallbacks") == 0, "degraded to local reads"
+
+doc = subprocess.run([sys.executable, "tools/tfrecord_doctor.py",
+                      "serve-status", spec],
+                     capture_output=True, text=True)
+assert doc.returncode == 0, (doc.returncode, doc.stdout, doc.stderr)
+lines = [json.loads(l) for l in doc.stdout.splitlines() if l.strip()]
+svc = [l for l in lines if l.get("event") == "service"
+       and l.get("partition") == owner][0]
+assert svc.get("failed_over") and svc.get("generation", 0) >= 1, svc
+ha = [l for l in lines if l.get("event") == "ha"][0]
+assert ha["answered"] == 2 and ha["failed_over"] >= 1, ha
+
+for p in procs:
+    if p.poll() is None:
+        p.terminate()
+for p in procs:
+    if p.poll() is None:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+print("HA smoke OK:", json.dumps({
+    "rows": len(got),
+    "owner_partition": owner,
+    "failed_over_generation": svc.get("generation"),
+    "reconnects": METRICS.counter("service.reconnects"),
+}))
+PY
+
 echo "== tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log

@@ -190,6 +190,45 @@ def durable_write(
         raise
 
 
+class FencedWriteError(OSError):
+    """A ``durable_append`` found the file replaced underneath it (the
+    inode changed): some other writer committed a NEW artifact at the
+    same path — for the dispatcher journal, a promoted standby that
+    bumped the generation. The append was NOT performed. OSError-shaped
+    so existing journal-failure accounting treats it as a write failure,
+    while callers that care (zombie-primary fencing) can tell it apart."""
+
+
+def durable_append(
+    path: str, data: bytes, expect_ino: Optional[int] = None
+) -> int:
+    """Append one record to ``path`` durably: open in append mode, write,
+    flush, fsync — so a committed record survives a host crash, and a
+    crash mid-append tears at most the UNCOMMITTED tail (readers of
+    append-mode journals must replay to the newest consistent prefix;
+    the dispatcher journal's line framing makes the torn tail
+    detectable). Returns the file's inode.
+
+    ``expect_ino`` is the fencing seam: when given and the opened file's
+    inode differs, the file was atomically replaced by another writer
+    (``durable_write``/``os.replace`` gives the path a fresh inode) and
+    ``FencedWriteError`` is raised BEFORE any byte lands — a fenced
+    writer can never interleave stale records into its successor's
+    journal. graftlint's atomic-write rule recognizes this append+fsync
+    shape (appends never tear previously committed bytes)."""
+    with open(path, "ab") as fh:
+        st = os.fstat(fh.fileno())
+        if expect_ino is not None and st.st_ino != expect_ino:
+            raise FencedWriteError(
+                f"{path} was replaced underneath this writer "
+                f"(inode {st.st_ino} != expected {expect_ino})"
+            )
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+        return st.st_ino
+
+
 def save_state(
     directory: str,
     state_or_iterator,

@@ -38,11 +38,22 @@ byte stream is identical across ANY resize, because shard ownership is
 consumer-tracked (acked offsets + redelivered-prefix dedupe) and the
 per-shard route merely picks WHO decodes — never what is decoded.
 
+Since the HA PR (ISSUE 17) the lease space is *partitioned* across K
+dispatchers, and one scaler federates over all of them: ``dispatcher``
+may be a list (local objects and/or ``DispatcherHandle`` remote
+proxies). The census merges every partition's books deduping by
+worker id (a worker registers with EVERY partition); a drain victim is
+drained on every partition; and — the failover whipsaw guard — if ANY
+partition's status is unreadable the tick is non-actionable
+(``elastic.census_errors``): a fleet mid-failover is never resized on a
+partial view.
+
 Counters (in the scaler/dispatcher process): ``elastic.scale_ups``
 (spawn decisions), ``elastic.scale_downs`` (drain decisions),
 ``elastic.drains`` (drains completed — goodbye received),
 ``elastic.drained_leases`` (leases handed back at drain),
-``elastic.spawn_errors``. Gauge: ``elastic.workers``.
+``elastic.spawn_errors``, ``elastic.census_errors`` (a partition's
+status was unreadable — tick skipped). Gauge: ``elastic.workers``.
 """
 
 from __future__ import annotations
@@ -60,6 +71,7 @@ from tpu_tfrecord.metrics import METRICS, logger
 __all__ = [
     "ScalerPolicy",
     "FleetScaler",
+    "DispatcherHandle",
     "SubprocessSpawner",
     "subprocess_spawner",
 ]
@@ -95,12 +107,16 @@ class ScalerPolicy:
 class FleetScaler:
     """Fleet-level bounded hill-climbing over the decode-worker count.
 
-    One scaler per dispatcher (the one-dispatcher-per-fleet caveat from
-    PR 8 extends naturally: the scaler lives in the dispatcher's process
-    and is the only thing that spawns or drains workers — two scalers
-    over one fleet would fight). ``step()`` is one decision tick; pass
-    ``interval_s`` and call ``start()`` for the production thread, or
-    drive ``step()`` directly with an injected clock in tests.
+    One scaler per FLEET — it is the only thing that spawns or drains
+    workers (two scalers over one fleet would fight). ``dispatcher`` is
+    a single dispatcher (PR 12 shape) or, under partitioning, a list of
+    one per partition — local ``ServiceDispatcher`` objects and/or
+    ``DispatcherHandle`` proxies for partitions hosted elsewhere. The
+    scaler's verdict block is published to every partition so
+    ``serve-status`` shows it no matter which one is asked. ``step()``
+    is one decision tick; pass ``interval_s`` and call ``start()`` for
+    the production thread, or drive ``step()`` directly with an injected
+    clock in tests.
 
     The verdict source is either a spool directory (a
     ``fleet.TelemetryAggregator`` is built over it) or an injected
@@ -132,7 +148,14 @@ class FleetScaler:
             aggregator = fleet.TelemetryAggregator(
                 spool_dir, trace_id=trace_id
             )
-        self.dispatcher = dispatcher
+        if isinstance(dispatcher, (list, tuple)):
+            if not dispatcher:
+                raise ValueError("dispatcher list must be non-empty")
+            self.dispatchers = list(dispatcher)
+        else:
+            self.dispatchers = [dispatcher]
+        #: partition 0, kept for the PR 12 single-dispatcher surface
+        self.dispatcher = self.dispatchers[0]
         self.spawn = spawn
         self.aggregator = aggregator
         self.policy = policy or ScalerPolicy()
@@ -157,17 +180,57 @@ class FleetScaler:
         self._last_verdict: Optional[str] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        # surface ourselves on the dispatcher's status() page
-        self.dispatcher.scaler_status = self.status(workers=0, draining=[])
+        # surface ourselves on every partition's status() page
+        self._publish(self.status(workers=0, draining=[]))
 
     # -- census ----------------------------------------------------------------
 
-    def _census(self) -> Dict[str, Any]:
-        """Who is in the fleet right now, from the dispatcher's books:
-        active (alive, not draining), draining (alive, marked), and the
-        pending spawns that have not registered yet."""
-        st = self.dispatcher.status()
-        ids = {w["worker_id"] for w in st["workers"]}
+    def _publish(self, st: Dict[str, Any]) -> None:
+        """Push the scaler block onto every partition's status page (a
+        plain attribute set locally; one ``scaler_status`` RPC through a
+        ``DispatcherHandle``). A partition unreachable right now —
+        mid-failover — just misses one refresh; the next tick re-pushes."""
+        for d in self.dispatchers:
+            try:
+                d.scaler_status = st
+            except OSError as e:
+                logger.warning(
+                    "tfrecord.elastic scaler-status publish failed: %s", e
+                )
+
+    def _census(self) -> Optional[Dict[str, Any]]:
+        """Who is in the fleet right now, merged over every partition's
+        books (workers register with ALL partitions — dedupe by worker
+        id; a worker is active if any partition sees it alive and
+        undraining, draining if any partition has it marked): active,
+        draining, and the pending spawns that have not registered yet.
+
+        Returns None when ANY partition's status is unreadable: during a
+        failover window one partition's books are in transit between
+        primary and standby, and a census over the remaining partitions
+        would double-count or miss workers — the whipsaw the climber's
+        hysteresis cannot see. The tick is skipped instead
+        (``elastic.census_errors``)."""
+        statuses = []
+        for i, d in enumerate(self.dispatchers):
+            try:
+                statuses.append(d.status())
+            except (OSError, RuntimeError) as e:
+                METRICS.count("elastic.census_errors")
+                logger.warning(
+                    "tfrecord.elastic census blind: partition %d "
+                    "unreadable (%s)", i, e
+                )
+                return None
+        seen: Dict[str, Dict[str, Any]] = {}
+        for st in statuses:
+            for w in st["workers"]:
+                prev = seen.setdefault(
+                    w["worker_id"], {"alive": False, "draining": False}
+                )
+                prev["alive"] = prev["alive"] or bool(w["alive"])
+                prev["draining"] = prev["draining"] or bool(w.get("draining"))
+        ids = set(seen)
         # registrations observed since the last tick retire pending spawns
         for _ in ids - self._known_ids:
             if self._pending:
@@ -179,14 +242,14 @@ class FleetScaler:
             if now - t < self.policy.pending_timeout_s
         ]
         active = sorted(
-            w["worker_id"] for w in st["workers"]
-            if w["alive"] and not w.get("draining")
+            wid for wid, w in seen.items()
+            if w["alive"] and not w["draining"]
         )
         draining = sorted(
-            w["worker_id"] for w in st["workers"]
-            if w["alive"] and w.get("draining")
+            wid for wid, w in seen.items()
+            if w["alive"] and w["draining"]
         )
-        return {"active": active, "draining": draining, "status": st}
+        return {"active": active, "draining": draining, "statuses": statuses}
 
     def _verdict(self) -> str:
         """Cluster verdict over the alive, still-running consumers; no
@@ -222,6 +285,12 @@ class FleetScaler:
         self._tick += 1
         pol = self.policy
         census = self._census()
+        if census is None:
+            # a partition is unreadable (failover in flight): the fleet
+            # view is partial, so neither the climber nor the floor
+            # check may act on it — and the stale published verdict is
+            # left in place rather than replaced with a blind one
+            return None
         active, draining = census["active"], census["draining"]
         effective = len(active) + len(self._pending)
         verdict = self._verdict()
@@ -242,9 +311,7 @@ class FleetScaler:
                 if decision is not None:
                     self._climber.acted()
         METRICS.gauge("elastic.workers", float(len(active)))
-        self.dispatcher.scaler_status = self.status(
-            workers=len(active), draining=draining
-        )
+        self._publish(self.status(workers=len(active), draining=draining))
         return decision
 
     def _spawn_one(self, effective: int, reason: str) -> Optional[Dict[str, Any]]:
@@ -266,7 +333,20 @@ class FleetScaler:
         # routing interleaves over the sorted alive list) the one whose
         # removal perturbs the fewest existing assignments
         victim = active[-1]
-        if not self.dispatcher.drain(victim):
+        # the victim holds leases on EVERY partition that routed work to
+        # it — each must hand them back; "drained" if any partition knew
+        # the worker at all (partitions that never routed to it answer
+        # False harmlessly)
+        drained = False
+        for i, d in enumerate(self.dispatchers):
+            try:
+                drained = bool(d.drain(victim)) or drained
+            except OSError as e:
+                logger.warning(
+                    "tfrecord.elastic drain of %s on partition %d "
+                    "failed: %s", victim, i, e
+                )
+        if not drained:
             return None
         METRICS.count("elastic.scale_downs")
         return self._record("scale_down", reason, {"workers": len(active),
@@ -330,11 +410,86 @@ class FleetScaler:
                 logger.warning("tfrecord.elastic step failed: %s", e)
 
 
+class DispatcherHandle:
+    """Remote-dispatcher proxy with exactly the surface ``FleetScaler``
+    touches — ``status()``, ``drain()``, and ``scaler_status``
+    assignment — so one scaler can federate over partitions it does not
+    host in-process. ``addrs`` is one partition's member list in
+    preference order (primary first, then its standby, i.e. one ``|``
+    group of the partition-map spec): every RPC walks the list and a
+    member answering ``not_primary`` (a standby, or a demoted zombie) is
+    skipped for primary-only ops, so the handle follows a failover
+    without reconfiguration."""
+
+    def __init__(self, addrs, timeout: float = 5.0):
+        if isinstance(addrs, str):
+            addrs = [a.strip() for a in addrs.split("|") if a.strip()]
+        if not addrs:
+            raise ValueError("DispatcherHandle needs at least one address")
+        self.addrs = [str(a) for a in addrs]
+        self.timeout = float(timeout)
+        self._scaler_status: Optional[Dict[str, Any]] = None
+
+    def _rpc(self, msg: Dict[str, Any], primary_only: bool) -> Dict[str, Any]:
+        from tpu_tfrecord import service as _service
+        from tpu_tfrecord import service_protocol as sp
+
+        last: Optional[BaseException] = None
+        for addr in self.addrs:
+            try:
+                sock = sp.connect(addr, timeout=self.timeout)
+                try:
+                    sock.settimeout(self.timeout)
+                    reply = sp.request(
+                        sock, addr,
+                        {**msg, "proto": _service.PROTO_VERSION},
+                    )
+                finally:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            except OSError as e:  # ProtocolError is a ConnectionError
+                last = e
+                continue
+            if primary_only and reply.get("error") == "not_primary":
+                last = OSError(f"{addr}: not primary")
+                continue
+            return reply
+        raise OSError(
+            f"no member of partition {self.addrs} answered: {last}"
+        )
+
+    def status(self) -> Dict[str, Any]:
+        return self._rpc({"op": "status"}, primary_only=False)
+
+    def drain(self, worker_id: str) -> bool:
+        reply = self._rpc(
+            {"op": "drain", "worker_id": str(worker_id)}, primary_only=True
+        )
+        return bool(reply.get("drained"))
+
+    @property
+    def scaler_status(self) -> Optional[Dict[str, Any]]:
+        return self._scaler_status
+
+    @scaler_status.setter
+    def scaler_status(self, st: Optional[Dict[str, Any]]) -> None:
+        # assignment IS the publish — mirrors the plain-attribute set on
+        # a local ServiceDispatcher; OSError propagates for the caller
+        # (FleetScaler._publish) to log
+        self._scaler_status = st
+        self._rpc({"op": "scaler_status", "status": st}, primary_only=False)
+
+
 class SubprocessSpawner:
     """The production ``spawn``: each call launches one
     ``python -m tpu_tfrecord.service worker`` subprocess pointed at the
-    dispatcher, with any extra CLI args appended (``--cache``,
-    ``--spool-dir``, ``--fault-plan`` for chaos replays, ...). Tracks its
+    dispatcher — ``dispatcher_addr`` may be a single ``host:port`` or a
+    full partition-map spec (``h:p1|h:p2,h:p3``), which the worker
+    parses to register with every partition — with any extra CLI args
+    appended (``--cache``, ``--spool-dir``, ``--fault-plan`` for chaos
+    replays, ...). Tracks its
     children so ``reap()`` can terminate whatever is still alive — a
     drained worker exits on its own; reap is the shutdown safety net."""
 

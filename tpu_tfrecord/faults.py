@@ -44,7 +44,9 @@ from __future__ import annotations
 
 import contextlib
 import json
+import os
 import random
+import signal
 import threading
 import time
 from dataclasses import asdict, dataclass, field
@@ -58,6 +60,13 @@ FAULT_KINDS = (
     "rename_race",
     "flaky_listing",
     "disconnect",
+    # Dispatcher-targeted kinds (ISSUE 17 HA chaos):
+    "torn_write",  # journal record torn at cap_bytes, then the write errors
+    # — the host-crash-mid-append scenario journal replay must survive
+    "sigkill",  # SIGKILL the CURRENT process at the matching call — the
+    # primary-dies-mid-journal-write scenario (subprocess scenarios only)
+    "netsplit",  # connect/recv permanently refused: the standby-partition
+    # scenario, ledgered distinctly from an ordinary permanent_error
     # HTTP-request kinds (op="http"), executed by the fault-injecting
     # Range server (tpu_tfrecord.httpfs.serve_directory) — faults that
     # fire at the REAL socket level, not inside a wrapped file object:
@@ -87,7 +96,15 @@ FAULT_KINDS = (
 #: TCP connection. ``connect`` rules also apply to the HTTP client's
 #: connection establishment (peer "host:port"): a transient/permanent
 #: error there IS connection-refused as the client observes it.
-FAULT_OPS = ("open", "read", "rename", "listdir", "connect", "recv", "http")
+#: ``journal`` is the dispatcher-journal write seam (tpu_tfrecord.service
+#: consults the installed plan around every journal append/compaction;
+#: the matched path is the journal file path): ``torn_write`` lands a
+#: cap_bytes prefix of the record on disk and then errors (the
+#: crash-mid-append tear standby replay must absorb), ``sigkill`` kills
+#: the dispatcher process at the write, and transient/permanent errors
+#: exercise the journal-failure self-demotion path.
+FAULT_OPS = ("open", "read", "rename", "listdir", "connect", "recv", "http",
+             "journal")
 
 #: kinds only the fault-injecting HTTP server executes (op="http").
 HTTP_ONLY_KINDS = (
@@ -163,6 +180,16 @@ class FaultRule:
             raise ValueError("http_error requires a 4xx/5xx status")
         if self.kind == "bad_content_range" and self.shift_bytes == 0:
             raise ValueError("bad_content_range requires shift_bytes != 0")
+        if self.kind == "torn_write":
+            if self.op != "journal":
+                # tearing a record mid-write is a journal-append shape;
+                # on any other op it would ledger as fired and do nothing
+                raise ValueError("torn_write requires op='journal'")
+            if self.cap_bytes < 1:
+                raise ValueError("torn_write requires cap_bytes >= 1 (how "
+                                 "many record bytes land before the tear)")
+        if self.kind == "netsplit" and self.op not in ("connect", "recv"):
+            raise ValueError("netsplit requires op='connect' or op='recv'")
 
     def matches_path(self, path: str) -> bool:
         return self.path in path
@@ -254,7 +281,7 @@ class FaultPlan:
                 }
                 if rule.kind in ("stall", "trickle"):
                     entry["stall_ms"] = rule.stall_ms
-                if rule.kind == "short_read":
+                if rule.kind in ("short_read", "torn_write"):
                     entry["cap_bytes"] = rule.cap_bytes
                 if rule.kind == "http_error":
                     entry["status"] = rule.status
@@ -293,12 +320,22 @@ class FaultPlan:
                 c = fault["_rule"].cap_bytes
                 if size is None or size < 0 or size > c:
                     cap = c if cap is None else min(cap, c)
+            elif kind == "sigkill":
+                self._sigkill()
             elif kind in ("transient_error", "permanent_error", "flaky_listing"):
                 self._raise_for(fault)
             # rename_race is handled at the rename call site (the rename
             # must LAND before the error) — see ChaosFS.rename;
             # disconnect is socket-only — see apply_socket
         return cap
+
+    @staticmethod
+    def _sigkill() -> None:
+        """The process-death fault: SIGKILL ourselves, exactly the way a
+        chaos test kills a primary dispatcher — no handlers, no cleanup,
+        fds closed by the kernel. Only meaningful in subprocess
+        scenarios (an in-process test would kill the test runner)."""
+        os.kill(os.getpid(), signal.SIGKILL)
 
     def apply_socket(
         self, op: str, addr: str, sock=None, size: Optional[int] = None
@@ -324,9 +361,39 @@ class FaultPlan:
                     except OSError:
                         pass
                 self._raise_for(fault)
+            elif kind == "sigkill":
+                self._sigkill()
             else:
+                # transient_error / permanent_error / netsplit: netsplit
+                # raises identically to permanent_error but ledgers under
+                # its own kind — a partitioned standby and a crashed peer
+                # are different scenarios worth telling apart in a replay
                 self._raise_for(fault)
         return cap
+
+    def apply_journal(self, path: str, data: bytes) -> None:
+        """Run the plan for one dispatcher-journal write (``op="journal"``
+        against the journal path, ``data`` the full record about to land):
+        stalls sleep, errors raise, ``sigkill`` kills the process, and
+        ``torn_write`` writes the first ``cap_bytes`` of the record
+        DIRECTLY to the journal and then raises — the bytes a host crash
+        mid-append would have left behind, which replay must absorb as a
+        torn tail."""
+        for fault in self.decide("journal", path):
+            kind = fault["kind"]
+            if kind == "stall":
+                self.sleep(fault["_rule"].stall_ms / 1000.0)
+            elif kind == "sigkill":
+                self._sigkill()
+            elif kind == "torn_write":
+                torn = data[: fault["_rule"].cap_bytes]
+                with open(path, "ab") as fh:
+                    fh.write(torn)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                self._raise_for(fault)
+            else:
+                self._raise_for(fault)
 
 
 class _ChaosFile:
@@ -434,6 +501,7 @@ def install_chaos(plan: FaultPlan):
     in-flight default-sleep stalls."""
     from tpu_tfrecord import fs as _fs
     from tpu_tfrecord import httpfs as _httpfs
+    from tpu_tfrecord import service as _service
     from tpu_tfrecord import service_protocol as _sp
     from tpu_tfrecord.io import dataset as _dataset
 
@@ -442,6 +510,7 @@ def install_chaos(plan: FaultPlan):
     orig_open_local = _dataset._open_local
     orig_chaos_plan = _sp._CHAOS_PLAN
     orig_http_plan = _httpfs._CHAOS_PLAN
+    orig_journal_plan = _service._JOURNAL_CHAOS
 
     def chaos_filesystem_for(path: str):
         return ChaosFS(orig_filesystem_for(path), plan)
@@ -461,6 +530,9 @@ def install_chaos(plan: FaultPlan):
     # there is connection-refused exactly as the client observes it
     _sp._CHAOS_PLAN = plan
     _httpfs._CHAOS_PLAN = plan
+    # the dispatcher-journal write seam: every journal append/compaction
+    # consults the plan under op="journal" (torn_write / sigkill / errors)
+    _service._JOURNAL_CHAOS = plan
     try:
         yield plan
     finally:
@@ -469,4 +541,5 @@ def install_chaos(plan: FaultPlan):
         _dataset._open_local = orig_open_local
         _sp._CHAOS_PLAN = orig_chaos_plan
         _httpfs._CHAOS_PLAN = orig_http_plan
+        _service._JOURNAL_CHAOS = orig_journal_plan
         plan.release()

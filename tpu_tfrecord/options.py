@@ -164,11 +164,16 @@ class TFRecordOptions:
         configured pulse interval wins — the controller always runs at
         pulse boundaries).
       - service: disaggregated data service (tpu_tfrecord.service).
-        ``"host:port"`` of the dispatcher makes this dataset's iterators
-        fetch decoded chunks from leased decode-worker processes instead
-        of decoding locally — batches, checkpoints, and shuffling are
-        byte-identical either way (the service is an alternative chunk
-        source under the same pipeline). None (default) = decode locally.
+        ``"host:port"`` of the dispatcher — or a full partition-map spec
+        (``"h:p1|h:p2,h:p3"``: comma-separated partitions, each
+        ``primary|standby``; or ``"@map.json"``) — makes this dataset's
+        iterators fetch decoded chunks from leased decode-worker
+        processes instead of decoding locally; under a partition map the
+        dataset routes to the partition owning its tenant digest and
+        fails over to the standby. Batches, checkpoints, and shuffling
+        are byte-identical either way (the service is an alternative
+        chunk source under the same pipeline). None (default) = decode
+        locally.
       - service_lease_ttl_s: dispatcher-side lease TTL — a worker whose
         heartbeat is older than this loses its leases and its shards are
         reassigned. Consumed by the dispatcher (``python -m
@@ -474,9 +479,11 @@ class TFRecordOptions:
         service = merged.pop("service", None)
         if service is not None:
             service = str(service)
-            from tpu_tfrecord.service_protocol import parse_addr
+            from tpu_tfrecord.service import PartitionMap
 
-            parse_addr(service)  # loud on anything that isn't host:port
+            # loud on anything that is neither a host:port nor a
+            # partition-map spec ("h:p1|h:p2,h:p3" / "@map.json")
+            PartitionMap.parse(service)
         service_lease_ttl_s = float(
             merged.pop("service_lease_ttl_s", merged.pop("serviceLeaseTtlS", 10.0))
         )

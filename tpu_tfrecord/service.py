@@ -25,12 +25,25 @@ Robustness is the contract, not a feature:
   redelivered prefix (``service.redelivered_dropped``) — redelivery can
   never double-count, and a worker that dies mid-chunk can never leave a
   hole, because the next worker decodes the same deterministic stream.
-- **Dispatcher death**: every assignment-state mutation is journaled to an
-  atomically-rewritten file (``telemetry.atomic_write_bytes``); a
-  restarted dispatcher replays it (workers, leases, done set,
-  reassignment count, trace identity) and workers re-register through
-  their heartbeat loop. Consumers ride ``RetryPolicy``-shaped backoff
-  through the outage and resume from their acked position.
+- **Dispatcher death**: every assignment-state mutation is journaled —
+  one fsynced delta line per mutation over a durable snapshot line
+  (``checkpoint.durable_append`` / ``durable_write``); a restarted
+  dispatcher replays the newest consistent prefix (workers, leases, done
+  set, reassignment count, trace identity) and workers re-register
+  through their heartbeat loops. Consumers ride ``RetryPolicy``-shaped
+  backoff through the outage and resume from their acked position.
+  ISSUE 17 removes the dispatcher SPOF outright: the lease space is
+  **partitioned** across K dispatchers by rendezvous-hashing the tenant
+  digest over a static ``PartitionMap`` (no coordination service — every
+  consumer/worker/scaler parses the same spec), and each partition gets
+  a **warm standby** that tails the primary's journal, detects death by
+  ping loss, promotes itself with a bumped fencing generation (the
+  journal compaction's ``os.replace`` gives the file a new inode, so a
+  resurrected zombie's next append is rejected —
+  ``service.fenced_writes`` — and the zombie demotes), and best-effort
+  adopts the dead primary's advertised address. A primary whose journal
+  writes keep failing demotes ITSELF (``service.demotions``) rather than
+  run unjournaled under a standby that would recover stale state.
 - **Service unreachable**: past ``service_fallback_ms`` without progress
   the consumer degrades to DIRECT LOCAL reads of the same shard
   (``service.fallbacks``) — byte-identical rows either way, because the
@@ -102,6 +115,97 @@ DEFAULT_LEASE_TTL_S = 10.0
 #: constructed-dataset cache entries a decode worker keeps (one per job
 #: digest); beyond this the oldest job's dataset is evicted.
 MAX_CACHED_JOBS = 4
+
+#: journal format version written by this code. v2 is line-oriented:
+#: first line a full-state ``snapshot`` record (carrying the fencing
+#: ``generation``), then one delta record per mutation, each appended
+#: fsync-before-return (``checkpoint.durable_append``). Replay folds the
+#: newest consistent prefix — a torn tail (host crash mid-append) is
+#: dropped, never fatal. v1 (a single atomically-rewritten JSON object)
+#: is still replayed for backward compatibility.
+JOURNAL_VERSION = 2
+
+#: delta appends between snapshot compactions (bounds replay cost and
+#: keeps the standby's tail cheap).
+JOURNAL_COMPACT_EVERY = 256
+
+#: consecutive journal write failures before a primary demotes itself
+#: (stops granting leases). An unjournaled primary is worse than a dead
+#: one: a standby would take over from a stale journal.
+JOURNAL_DEMOTE_AFTER = 3
+
+#: consecutive failed primary pings before a warm standby takes over.
+STANDBY_TAKEOVER_MISSES = 3
+
+#: set by faults.install_chaos: every dispatcher-journal write consults
+#: this plan under op="journal" (torn_write / sigkill / errors).
+_JOURNAL_CHAOS = None
+
+
+class PartitionMap:
+    """The static partition map: K lease-space partitions, each a primary
+    dispatcher address plus optional warm standbys, with NO coordination
+    service — consumers, workers, and the ``FleetScaler`` all parse the
+    same spec string and agree on ownership by rendezvous-hashing the
+    tenant digest.
+
+    Spec grammar (the ``service`` option / ``--dispatcher`` flag):
+
+    - ``"host:port"`` — one partition, no standby (the pre-HA form);
+    - ``"host:port|host:port2"`` — one partition with a warm standby;
+    - ``"h:p1|h:p2,h:p3|h:p4"`` — two partitions, each with a standby;
+    - ``"@/path/map.json"`` — read ``{"partitions": [["h:p", ...], ...]}``
+      from a file (the fleet-config deployment shape).
+
+    Ownership is highest-random-weight (rendezvous) hashing of
+    ``tenant_digest`` over partition indices: deterministic everywhere,
+    no ring state, and growing K from N to N+1 remaps only ~1/(N+1) of
+    tenants."""
+
+    def __init__(self, partitions: List[List[str]]):
+        if not partitions or any(not p for p in partitions):
+            raise ValueError("partition map needs >= 1 address per partition")
+        self.partitions = [[str(a) for a in p] for p in partitions]
+        for group in self.partitions:
+            for addr in group:
+                sp.parse_addr(addr)  # loud on anything that isn't host:port
+
+    @staticmethod
+    def parse(spec: str) -> "PartitionMap":
+        spec = str(spec).strip()
+        if spec.startswith("@"):
+            with open(spec[1:], "rb") as fh:
+                obj = json.loads(fh.read().decode("utf-8"))
+            return PartitionMap([list(p) for p in obj["partitions"]])
+        return PartitionMap(
+            [
+                [a.strip() for a in part.split("|") if a.strip()]
+                for part in spec.split(",")
+                if part.strip()
+            ]
+        )
+
+    @property
+    def k(self) -> int:
+        return len(self.partitions)
+
+    def partition_for(self, tenant: str) -> int:
+        """Rendezvous hash: the partition whose (index, tenant) score is
+        highest owns the tenant's lease space. Same inputs, same owner,
+        on every consumer/worker/scaler — no coordination needed."""
+        return max(
+            range(len(self.partitions)),
+            key=lambda i: hashlib.sha256(
+                f"{i}|{tenant}".encode()
+            ).digest(),
+        )
+
+    def addrs(self, partition: int) -> List[str]:
+        """Primary first, then standbys, for one partition."""
+        return list(self.partitions[partition])
+
+    def to_spec(self) -> str:
+        return ",".join("|".join(p) for p in self.partitions)
 
 
 class _ConnTracker:
@@ -228,9 +332,14 @@ class _WorkerInfo:
 class ServiceDispatcher:
     """Owns shard->worker leasing and nothing else — no data bytes ever
     flow through it. All mutable assignment state (workers, leases, done
-    set, reassignment count, trace identity) is journaled via
-    ``atomic_write_bytes`` on every mutation, so a crash loses at most the
-    heartbeat freshness (which workers re-supply within one TTL).
+    set, reassignment count, trace identity) is journaled on every
+    mutation — one fsynced delta line via ``checkpoint.durable_append``
+    over a durable snapshot line — so a crash loses at most the
+    heartbeat freshness (which workers re-supply within one TTL). The
+    same instance is also the partition's warm STANDBY when built with
+    ``standby_of=<primary addr>``: it tails the shared journal, rejects
+    lease-path ops with ``not_primary``, and promotes itself (generation
+    bump = zombie fence) when the primary stops answering pings.
 
     Lease model: ``route`` picks the owner among the ALIVE workers with the
     interleaved assignment (``interleave_owner`` over the sorted alive
@@ -247,9 +356,20 @@ class ServiceDispatcher:
         journal: Optional[str] = None,
         lease_ttl_s: float = DEFAULT_LEASE_TTL_S,
         clock=time.monotonic,
+        standby_of: Optional[str] = None,
+        partition_index: int = 0,
+        generation: int = 0,
+        demote_after: int = JOURNAL_DEMOTE_AFTER,
+        takeover_misses: int = STANDBY_TAKEOVER_MISSES,
+        ping_interval_s: Optional[float] = None,
+        takeover_addr: bool = True,
     ):
         if lease_ttl_s <= 0:
             raise ValueError("lease_ttl_s must be > 0")
+        if standby_of is not None and journal is None:
+            raise ValueError(
+                "a standby needs the primary's journal path to tail"
+            )
         self.lease_ttl_s = float(lease_ttl_s)
         self.journal = journal
         self._clock = clock
@@ -267,12 +387,47 @@ class ServiceDispatcher:
         self._tenants: Dict[str, Dict[str, Any]] = {}
         #: written by an attached elastic.FleetScaler; surfaced in status()
         self.scaler_status: Optional[Dict[str, Any]] = None
+        # -- HA state (partitioning + failover + fencing) ------------------
+        self.partition_index = int(partition_index)
+        self.generation = int(generation)
+        #: None = acting primary; an address = warm standby tailing that
+        #: primary's journal, promoting itself on heartbeat loss
+        self._standby_of = str(standby_of) if standby_of is not None else None
+        self._role = "standby" if standby_of is not None else "dispatcher"
+        self.failed_over = False
+        #: True once journal writes failed ``demote_after`` times in a row
+        #: (or were fenced): a demoted primary grants NO leases — a
+        #: standby promoted off the journal must never race live state
+        #: that was silently running unjournaled
+        self._demoted = False
+        self._demote_after = max(1, int(demote_after))
+        self._journal_fail_streak = 0
+        #: a failed append leaves an undefined tail on disk: the next
+        #: successful write must be a full snapshot compaction
+        self._journal_dirty = False
+        self._journal_ino: Optional[int] = None
+        self._appends_since_compact = 0
+        self._takeover_misses = max(1, int(takeover_misses))
+        self.ping_interval_s = (
+            float(ping_interval_s)
+            if ping_interval_s is not None
+            else min(1.0, self.lease_ttl_s / 4.0)
+        )
+        self._takeover_addr = bool(takeover_addr)
+        self._extra_srvs: List[socket.socket] = []
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._conns = _ConnTracker()
-        self._ctx = telemetry.current_context().with_role("dispatcher")
+        self._ctx = telemetry.current_context().with_role(self._role)
         if journal is not None and os.path.exists(journal):
             self._replay_journal(journal)
+        if journal is not None and self._standby_of is None:
+            # a PRIMARY compacts at birth: one fresh fsynced snapshot
+            # carrying its generation, so standbys tail a well-formed v2
+            # journal from the first byte (and a replayed v1 journal is
+            # upgraded in place)
+            with self._lock:
+                self._compact_locked()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
@@ -280,24 +435,102 @@ class ServiceDispatcher:
         self.addr = sp.format_addr(host, self._srv.getsockname()[1])
 
     # -- journal ------------------------------------------------------------
+    #
+    # v2 layout: line 1 is a full-state ``snapshot`` record (carrying the
+    # fencing generation), every later line one delta record, each landed
+    # with ``checkpoint.durable_append`` (fsync before return) so committed
+    # records survive a host crash. Replay folds the NEWEST CONSISTENT
+    # PREFIX: a torn final line — crash or injected torn_write mid-append —
+    # is dropped, and anything after an unparseable record is ignored
+    # (records after a tear were written by a writer that already knew its
+    # append failed; the compact-on-next-write rule below repairs the file
+    # before they could exist). A v1 journal (single JSON object, no
+    # ``kind``) replays as a generation-0 snapshot.
 
     def _replay_journal(self, path: str) -> None:
-        """Restore assignment state from a previous incarnation. Journaled
-        workers get a fresh heartbeat grace of one TTL — they must
-        re-heartbeat (their loop re-registers on ``known: false``) or they
-        expire exactly like a SIGKILLed worker. The journaled trace
-        identity is re-adopted so the restarted dispatcher stays part of
-        the same logical run (one trace id across the restart)."""
+        """Restore assignment state from the journal. Journaled workers
+        get a fresh heartbeat grace of one TTL — they must re-heartbeat
+        (their loop re-registers on ``known: false``) or they expire
+        exactly like a SIGKILLed worker. The journaled trace identity is
+        re-adopted so the restarted (or promoted) dispatcher stays part
+        of the same logical run. Also the standby's continuous-catch-up
+        path: each tail tick re-reads and re-folds (journals are snapshot
+        + a bounded delta tail, so a full re-fold is cheap)."""
         try:
             with open(path, "rb") as fh:
-                obj = json.loads(fh.read().decode("utf-8"))
-        except (OSError, ValueError) as e:
+                data = fh.read()
+        except OSError as e:
             raise RuntimeError(f"unreadable dispatcher journal {path}: {e}")
+        records = self._parse_journal(data)
         now = self._clock()
-        # construction-time today, but the assignment books are the
-        # _lock-guarded state: hold the lock so a future caller (live
-        # re-replay, tests) gets the same contract as every other writer
+        trace = None
         with self._lock:
+            self._reset_state_locked()
+            for obj in records:
+                t = self._fold_locked(obj, now)
+                if t is not None:
+                    trace = t
+        if isinstance(trace, dict):
+            self._ctx = telemetry.adopt(
+                telemetry.TraceContext.from_json(trace).with_role(self._role)
+            )
+
+    @staticmethod
+    def _parse_journal(data: bytes) -> List[Dict[str, Any]]:
+        """Decode journal bytes to the newest consistent record prefix.
+        Empty -> no records. A whole-file JSON object (v1, written without
+        a trailing newline) -> one legacy snapshot. Otherwise v2 lines:
+        fold complete (newline-terminated) lines in order and STOP at the
+        first torn/unparseable one — replay-to-consistent-prefix, the
+        contract the truncation tests pin."""
+        if not data.strip():
+            return []
+        try:
+            whole = json.loads(data.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            whole = None
+        if isinstance(whole, dict) and whole.get("kind") is None:
+            return [dict(whole, kind="snapshot")]  # v1 full-state object
+        records: List[Dict[str, Any]] = []
+        lines = data.split(b"\n")
+        complete, tail = lines[:-1], lines[-1]
+        for raw in complete:
+            try:
+                obj = json.loads(raw.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                break  # mid-journal tear: keep the consistent prefix
+            if not isinstance(obj, dict) or "kind" not in obj:
+                break
+            records.append(obj)
+        # ``tail`` is bytes after the last newline: a torn final record
+        # (the fsync'd newline never landed) — dropped by construction
+        del tail
+        return records
+
+    def _reset_state_locked(self) -> None:
+        self._workers = {}
+        self._leases = {}
+        self._done = {}
+        self._reassignments = 0
+        self._draining = {}
+        self._tenants = {}
+
+    def _tenant_fold_locked(self, tenant: str) -> Dict[str, Any]:
+        info = self._tenants.get(tenant)
+        if info is None:
+            info = self._tenants[tenant] = {
+                "consumers": set(), "jobs": set(),
+                "shared_cache_hits": 0, "completions": 0,
+            }
+        return info
+
+    def _fold_locked(self, obj: Dict[str, Any], now: float):
+        """Apply one journal record to the assignment books. Returns the
+        trace dict when the record carries one (snapshot), else None."""
+        kind = obj.get("kind")
+        if kind == "snapshot":
+            self._reset_state_locked()
+            self.generation = max(self.generation, int(obj.get("generation", 0)))
             for wid, info in dict(obj.get("workers", {})).items():
                 self._workers[str(wid)] = _WorkerInfo(
                     str(wid), str(info["addr"]), int(info.get("pid", 0)), now
@@ -321,18 +554,53 @@ class ServiceDispatcher:
                     "shared_cache_hits": int(info.get("shared_cache_hits", 0)),
                     "completions": int(info.get("completions", 0)),
                 }
-        trace = obj.get("trace")
-        if isinstance(trace, dict):
-            self._ctx = telemetry.adopt(
-                telemetry.TraceContext.from_json(trace).with_role("dispatcher")
+            return obj.get("trace")
+        if kind == "register":
+            wid = str(obj["worker_id"])
+            self._workers[wid] = _WorkerInfo(
+                wid, str(obj["addr"]), int(obj.get("pid", 0)), now
             )
+            self._draining.pop(wid, None)
+        elif kind == "drain":
+            wid = str(obj["worker_id"])
+            if wid in self._workers:
+                self._draining[wid] = now
+            for k in [k for k, v in self._leases.items() if v == wid]:
+                del self._leases[k]
+        elif kind == "goodbye":
+            wid = str(obj["worker_id"])
+            self._workers.pop(wid, None)
+            self._draining.pop(wid, None)
+            for k in [k for k, v in self._leases.items() if v == wid]:
+                del self._leases[k]
+        elif kind == "lease":
+            key = str(obj["key"])
+            self._leases[key] = str(obj["worker_id"])
+            self._reassignments += int(obj.get("reassigned", 0))
+            info = self._tenant_fold_locked(key.split("/", 1)[0])
+            if obj.get("consumer") and len(info["consumers"]) < 1024:
+                info["consumers"].add(str(obj["consumer"]))
+            if obj.get("job") and len(info["jobs"]) < 1024:
+                info["jobs"].add(str(obj["job"]))
+        elif kind == "done":
+            key = str(obj["key"])
+            self._leases.pop(key, None)
+            self._done.setdefault(key, str(obj.get("worker_id", "")))
+            info = self._tenant_fold_locked(key.split("/", 1)[0])
+            info["completions"] += 1
+            if obj.get("cached"):
+                info["shared_cache_hits"] += 1
+        # unknown kinds fold to nothing: a NEWER writer's record types
+        # must not break an older replayer's consistent prefix
+        return None
 
-    def _journal_locked(self) -> None:
-        if self.journal is None:
-            return
-        payload = {
-            "version": 1,
+    def _snapshot_payload_locked(self) -> Dict[str, Any]:
+        return {
+            "kind": "snapshot",
+            "version": JOURNAL_VERSION,
+            "generation": self.generation,
             "lease_ttl_s": self.lease_ttl_s,
+            "partition": self.partition_index,
             "workers": {
                 w.worker_id: {"addr": w.addr, "pid": w.pid}
                 for w in self._workers.values()
@@ -352,15 +620,85 @@ class ServiceDispatcher:
             },
             "trace": self._ctx.to_json(),
         }
+
+    def _compact_locked(self) -> None:
+        """Rewrite the journal as one fresh snapshot line — durably
+        (fsync-before-rename via ``checkpoint.durable_write``, the PR 16
+        helper: standby correctness depends on journal bytes surviving a
+        host crash) and atomically (``os.replace`` gives the file a NEW
+        inode, which is the fence: a zombie primary's next
+        ``durable_append`` sees the inode change and is rejected before
+        any stale byte lands)."""
+        from tpu_tfrecord import checkpoint
+
+        if self.journal is None:
+            return
+        line = (
+            json.dumps(self._snapshot_payload_locked(), sort_keys=True).encode()
+            + b"\n"
+        )
+        plan = _JOURNAL_CHAOS
+        if plan is not None:
+            plan.apply_journal(self.journal, line)
+        checkpoint.durable_write(self.journal, line)
+        self._journal_ino = os.stat(self.journal).st_ino
+        self._appends_since_compact = 0
+        self._journal_dirty = False
+        self._journal_fail_streak = 0
+
+    def _journal_event_locked(self, event: Dict[str, Any]) -> None:
+        """Land one mutation record. Primaries only — a standby reads the
+        journal, never writes it. Failure policy (the satellite-2
+        contract): count every failure; after ``demote_after``
+        CONSECUTIVE failures, or a single fenced write (the file was
+        replaced by a promoted standby), demote — stop granting leases
+        rather than keep running unjournaled under a standby that would
+        recover stale state."""
+        from tpu_tfrecord import checkpoint
+
+        if self.journal is None or self._standby_of is not None or self._demoted:
+            return
         try:
-            telemetry.atomic_write_bytes(
-                self.journal, json.dumps(payload, sort_keys=True).encode()
+            if self._journal_dirty:
+                # the previous append failed partway: the on-disk tail is
+                # undefined, so the next durable write must be a full
+                # snapshot (which also covers this event's mutation)
+                self._compact_locked()
+                return
+            line = json.dumps(event, sort_keys=True).encode() + b"\n"
+            plan = _JOURNAL_CHAOS
+            if plan is not None:
+                plan.apply_journal(self.journal, line)
+            self._journal_ino = checkpoint.durable_append(
+                self.journal, line, expect_ino=self._journal_ino
             )
+            self._journal_fail_streak = 0
+            self._appends_since_compact += 1
+            if self._appends_since_compact >= JOURNAL_COMPACT_EVERY:
+                self._compact_locked()
+        except checkpoint.FencedWriteError as e:
+            # a promoted standby owns this journal now: one stale write
+            # attempt is all a zombie gets before it stops serving
+            METRICS.count("service.fenced_writes")
+            self._demote_locked("fenced", e)
         except OSError as e:
-            # a journal write failure must not take the control plane down
-            # mid-epoch — but it must be visible
             METRICS.count("service.journal_errors")
+            self._journal_dirty = True
+            self._journal_fail_streak += 1
             logger.warning("dispatcher journal write failed: %s", e)
+            if self._journal_fail_streak >= self._demote_after:
+                self._demote_locked("journal_errors", e)
+
+    def _demote_locked(self, reason: str, err: BaseException) -> None:
+        if self._demoted:
+            return
+        self._demoted = True
+        METRICS.count("service.demotions")
+        logger.warning(
+            "dispatcher demoted (%s): no further leases will be granted "
+            "(last error: %s)", reason, err,
+        )
+        telemetry.instant("service.demoted", reason=reason, error=str(err))
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -368,6 +706,10 @@ class ServiceDispatcher:
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
         self._threads.append(t)
+        if self._standby_of is not None:
+            s = threading.Thread(target=self._standby_loop, daemon=True)
+            s.start()
+            self._threads.append(s)
         return self
 
     def stop(self) -> None:
@@ -376,6 +718,11 @@ class ServiceDispatcher:
             self._srv.close()
         except OSError:
             pass
+        for srv in self._extra_srvs:
+            try:
+                srv.close()
+            except OSError:
+                pass
         self._conns.close_all()
         # Wait out the accept thread: while it is blocked in accept(2) the
         # kernel keeps the listening socket's file description — and the
@@ -392,15 +739,20 @@ class ServiceDispatcher:
     def __exit__(self, *exc) -> None:
         self.stop()
 
-    def _accept_loop(self) -> None:
-        self._srv.settimeout(0.2)
+    def _accept_loop(self, srv: Optional[socket.socket] = None) -> None:
+        srv = srv if srv is not None else self._srv
+        try:
+            srv.settimeout(0.2)
+        except OSError:
+            return  # stop() closed the listener before we first polled
         while not self._stop.is_set():
             try:
-                conn, _peer = self._srv.accept()
+                conn, _peer = srv.accept()
             except socket.timeout:
                 continue
             except OSError:
                 return
+            sp.enable_nodelay(conn)
             self._conns.track(conn)
             threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
@@ -424,6 +776,125 @@ class ServiceDispatcher:
             except OSError:
                 pass
 
+    # -- warm standby / failover --------------------------------------------
+
+    def _standby_loop(self) -> None:
+        """The warm-standby tick: tail the primary's journal (the PR 8
+        replay path reused for continuous catch-up — full re-fold of
+        snapshot + bounded delta tail), then ping the primary. After
+        ``takeover_misses`` consecutive failed pings — or a primary that
+        answers but admits it stopped accepting (demoted) — promote.
+        All waits ride the stop event (the injectable-wait seam);
+        cadence is ``ping_interval_s``."""
+        misses = 0
+        while not self._stop.wait(self.ping_interval_s):
+            if self._standby_of is None:
+                return  # promoted by an external call
+            try:
+                if os.path.exists(self.journal):
+                    self._replay_journal(self.journal)
+            except RuntimeError:
+                pass  # transiently unreadable: keep last good fold
+            if self._ping_primary():
+                misses = 0
+                continue
+            misses += 1
+            if misses >= self._takeover_misses:
+                self.promote()
+                return
+
+    def _ping_primary(self) -> bool:
+        addr = self._standby_of
+        if addr is None:
+            return True
+        try:
+            conn = sp.connect(addr, timeout=max(0.2, self.ping_interval_s))
+            try:
+                conn.settimeout(max(0.2, self.ping_interval_s))
+                reply = sp.request(
+                    conn, addr, {"op": "ping", "proto": PROTO_VERSION}
+                )
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+        except (OSError, sp.ProtocolError):
+            return False
+        # a primary that answers but no longer accepts (demoted after
+        # journal failures, or fenced) is DOWN for takeover purposes
+        return bool(reply.get("ok")) and bool(reply.get("accepting", True))
+
+    def promote(self) -> None:
+        """Standby -> acting primary. Bumps the generation and compacts
+        the journal (``durable_write`` -> new inode), which IS the fence:
+        the dead primary resurrected as a zombie fails its next append on
+        the inode change, counts ``service.fenced_writes``, and demotes.
+        Then best-effort takes over the dead primary's advertised address
+        (clients that never learned the standby's address reconnect to
+        the same host:port); clients that DO know it ride their partition
+        map's address rotation either way."""
+        with self._lock:
+            if self._standby_of is None:
+                return
+            primary_addr = self._standby_of
+            self._standby_of = None
+            self._role = "dispatcher"
+            self.failed_over = True
+            self._demoted = False
+            self.generation += 1
+            try:
+                self._compact_locked()
+            except OSError as e:
+                # promotion must not die on a journal hiccup — the next
+                # mutation retries the compaction via the dirty flag
+                METRICS.count("service.journal_errors")
+                self._journal_dirty = True
+                logger.warning("promotion compaction failed: %s", e)
+        self._ctx = telemetry.adopt(self._ctx.with_role("dispatcher"))
+        METRICS.count("service.failovers")
+        METRICS.gauge("service.partition", float(self.partition_index))
+        telemetry.instant(
+            "service.failover",
+            partition=self.partition_index,
+            generation=self.generation,
+            old_primary=primary_addr,
+            addr=self.addr,
+        )
+        logger.warning(
+            "standby took over partition %d (generation %d, old primary %s)",
+            self.partition_index, self.generation, primary_addr,
+        )
+        if self._takeover_addr:
+            self._adopt_address(primary_addr)
+
+    def _adopt_address(self, addr: str) -> None:
+        """Best-effort bind of the dead primary's advertised host:port as
+        an ADDITIONAL accept socket. On the same host this succeeds the
+        moment the primary's listener dies (SO_REUSEADDR); across hosts
+        (or while a zombie still holds the port) it fails quietly —
+        partition-map address rotation covers those clients."""
+        try:
+            host, port = sp.parse_addr(addr)
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind((host, port))
+            srv.listen(64)
+        except OSError as e:
+            logger.warning(
+                "could not take over advertised address %s: %s", addr, e
+            )
+            return
+        self._extra_srvs.append(srv)
+        t = threading.Thread(
+            target=self._accept_loop, args=(srv,), daemon=True
+        )
+        t.start()
+        self._threads.append(t)
+        telemetry.instant("service.failover", adopted_addr=addr,
+                          partition=self.partition_index,
+                          generation=self.generation)
+
     # -- request handling ---------------------------------------------------
 
     def _handle(self, msg: Dict[str, Any]) -> Dict[str, Any]:
@@ -431,6 +902,19 @@ class ServiceDispatcher:
         if msg.get("proto", PROTO_VERSION) != PROTO_VERSION:
             return {"error": "proto_mismatch", "proto": PROTO_VERSION}
         try:
+            if op in ("route", "shard_done", "drain") and not self.accepting:
+                # a standby (or a demoted zombie) grants NOTHING: route
+                # and completion records belong to the acting primary's
+                # journal. Workers' register/heartbeat still land (the
+                # standby keeps fleet freshness warm for takeover) and
+                # status/ping answer honestly.
+                METRICS.count("service.not_primary_rejects")
+                return {
+                    "error": "not_primary",
+                    "role": self._role,
+                    "demoted": self._demoted,
+                    "primary": self._standby_of,
+                }
             if op == "register_worker":
                 return self._op_register(msg)
             if op == "heartbeat":
@@ -441,13 +925,30 @@ class ServiceDispatcher:
                 return self._op_shard_done(msg)
             if op == "goodbye":
                 return self._op_goodbye(msg)
+            if op == "drain":
+                return {"ok": True,
+                        "drained": self.drain(str(msg["worker_id"]))}
+            if op == "scaler_status":
+                # a federated FleetScaler running elsewhere publishes its
+                # verdict here so serve-status shows it on every partition
+                st = msg.get("status")
+                self.scaler_status = dict(st) if isinstance(st, dict) else None
+                return {"ok": True}
             if op == "status":
                 return self.status()
             if op == "ping":
-                return {"ok": True, "role": "dispatcher"}
+                return {"ok": True, "role": self._role,
+                        "accepting": self.accepting,
+                        "generation": self.generation}
             return {"error": f"unknown op {op!r}"}
         except (KeyError, TypeError, ValueError) as e:
             return {"error": f"malformed {op!r} request: {e}"}
+
+    @property
+    def accepting(self) -> bool:
+        """Is this process the acting, non-demoted primary for its
+        partition — the only state in which leases may be granted?"""
+        return self._standby_of is None and not self._demoted
 
     def _alive_locked(self, now: float) -> List[str]:
         return sorted(
@@ -466,7 +967,10 @@ class ServiceDispatcher:
             # journal-replayed identity coming back): any old drain mark
             # belonged to its previous life
             self._draining.pop(wid, None)
-            self._journal_locked()
+            self._journal_event_locked(
+                {"kind": "register", "worker_id": wid,
+                 "addr": str(msg["addr"]), "pid": int(msg.get("pid", 0))}
+            )
         return {
             "ok": True,
             "worker_id": wid,
@@ -501,7 +1005,7 @@ class ServiceDispatcher:
             released = [k for k, v in self._leases.items() if v == wid]
             for k in released:
                 del self._leases[k]
-            self._journal_locked()
+            self._journal_event_locked({"kind": "drain", "worker_id": wid})
         if released:
             METRICS.count("elastic.drained_leases", len(released))
         telemetry.instant(
@@ -520,7 +1024,7 @@ class ServiceDispatcher:
             was_draining = self._draining.pop(wid, None) is not None
             for k in [k for k, v in self._leases.items() if v == wid]:
                 del self._leases[k]
-            self._journal_locked()
+            self._journal_event_locked({"kind": "goodbye", "worker_id": wid})
         if known and was_draining:
             METRICS.count("elastic.drains")
             telemetry.instant("elastic.drain_complete", worker=wid)
@@ -575,8 +1079,10 @@ class ServiceDispatcher:
                 return {"error": "no_workers"}
             wid = candidates[interleave_owner(shard_index, len(candidates))]
             prev = self._leases.get(key)
+            reassigned = False
             if prev is not None and prev != wid:
                 if prev not in alive or prev in exclude:
+                    reassigned = True
                     self._reassignments += 1
                     METRICS.count("service.lease_reassignments")
                     telemetry.instant(
@@ -585,7 +1091,11 @@ class ServiceDispatcher:
                     )
             if prev != wid:
                 self._leases[key] = wid
-                self._journal_locked()
+                self._journal_event_locked(
+                    {"kind": "lease", "key": key, "worker_id": wid,
+                     "reassigned": int(reassigned),
+                     "consumer": msg.get("consumer"), "job": msg.get("job")}
+                )
             return {
                 "ok": True,
                 "worker": self._workers[wid].addr,
@@ -612,7 +1122,11 @@ class ServiceDispatcher:
                 # pay-decode-once payoff, made countable
                 info["shared_cache_hits"] += 1
                 METRICS.count("service.shared_cache_hits")
-            self._journal_locked()
+            self._journal_event_locked(
+                {"kind": "done", "key": key, "worker_id": wid,
+                 "cached": bool(msg.get("cached")),
+                 "consumer": msg.get("consumer"), "job": msg.get("job")}
+            )
         return {"ok": True}
 
     def status(self) -> Dict[str, Any]:
@@ -657,8 +1171,14 @@ class ServiceDispatcher:
             }
             out = {
                 "ok": True,
-                "role": "dispatcher",
+                "role": self._role,
                 "addr": self.addr,
+                "partition": self.partition_index,
+                "generation": self.generation,
+                "accepting": self._standby_of is None and not self._demoted,
+                "demoted": self._demoted,
+                "failed_over": self.failed_over,
+                "standby_of": self._standby_of,
                 "lease_ttl_s": self.lease_ttl_s,
                 "workers": workers,
                 "alive": len(alive),
@@ -704,7 +1224,13 @@ class DecodeWorker:
         clock=time.monotonic,
         sleep=None,
     ):
-        self.dispatcher_addr = str(dispatcher_addr)
+        # ``dispatcher_addr`` accepts the full PartitionMap spec: a worker
+        # registers with (and heartbeats) EVERY partition, one beat loop
+        # per partition, rotating primary -> standby on transport failure
+        # — so any partition can route work here, and a promoted standby
+        # hears from the fleet within one beat
+        self._partition_map = PartitionMap.parse(dispatcher_addr)
+        self.dispatcher_addr = self._partition_map.addrs(0)[0]
         self._options = options
         self._role = role
         # drain completes only after the worker has been idle (no fetch
@@ -718,6 +1244,8 @@ class DecodeWorker:
         self._draining = threading.Event()
         #: set once the goodbye has been sent and the worker stopped
         self.drained = threading.Event()
+        self._beat_lock = threading.Lock()
+        self._beat_loops_left = 0
         self._stop = threading.Event()
         self._sleep = sleep if sleep is not None else self._stop.wait
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -739,9 +1267,16 @@ class DecodeWorker:
             target=self._accept_loop, daemon=True
         )
         self._accept_thread.start()
-        beat = threading.Thread(target=self._beat_loop, daemon=True)
-        beat.start()
-        self._threads += [self._accept_thread, beat]
+        self._threads.append(self._accept_thread)
+        self._beat_loops_left = self._partition_map.k
+        for part in range(self._partition_map.k):
+            beat = threading.Thread(
+                target=self._beat_loop,
+                args=(self._partition_map.addrs(part),),
+                daemon=True,
+            )
+            beat.start()
+            self._threads.append(beat)
         return self
 
     def stop(self) -> None:
@@ -769,22 +1304,30 @@ class DecodeWorker:
 
     # -- dispatcher side ----------------------------------------------------
 
-    def _beat_loop(self) -> None:
-        """Register, then heartbeat at TTL/3 forever. Any transport error
-        (dispatcher crashed/restarting) just backs off and retries — a
-        restarted dispatcher answers ``known: false`` until we re-register,
-        which this loop does on the next beat."""
+    def _beat_loop(self, addrs: List[str]) -> None:
+        """Register, then heartbeat at TTL/3 forever — one loop per
+        PARTITION, against whichever of the partition's addresses
+        (primary first, then standbys) currently answers. Any transport
+        error (dispatcher crashed/restarting, primary dead awaiting
+        takeover) rotates to the partition's next address, backs off, and
+        retries — a restarted dispatcher answers ``known: false`` until
+        we re-register, which this loop does on the next beat; a standby
+        accepts register/heartbeat too, keeping fleet freshness warm for
+        its takeover."""
         conn: Optional[socket.socket] = None
         registered = False
         backoff = 0.05
+        addr_idx = 0
+        addr = addrs[0]
         while not self._stop.is_set():
             try:
                 if conn is None:
-                    conn = sp.connect(self.dispatcher_addr, timeout=5.0)
+                    addr = addrs[addr_idx % len(addrs)]
+                    conn = sp.connect(addr, timeout=5.0)
                 if not registered:
                     reply = sp.request(
                         conn,
-                        self.dispatcher_addr,
+                        addr,
                         {
                             "op": "register_worker",
                             "proto": PROTO_VERSION,
@@ -807,7 +1350,7 @@ class DecodeWorker:
                 else:
                     reply = sp.request(
                         conn,
-                        self.dispatcher_addr,
+                        addr,
                         {
                             "op": "heartbeat",
                             "proto": PROTO_VERSION,
@@ -827,14 +1370,12 @@ class DecodeWorker:
                     if self._drain_ready():
                         try:
                             sp.request(
-                                conn, self.dispatcher_addr,
+                                conn, addr,
                                 {"op": "goodbye", "proto": PROTO_VERSION,
                                  "worker_id": self.worker_id},
                             )
                         finally:
-                            METRICS.count("service.worker_drained")
-                            self.drained.set()
-                            self.stop()
+                            self._beat_loop_finished()
                         return
                     self._sleep(min(0.1, self.drain_grace_s / 2 or 0.1))
                     continue
@@ -847,8 +1388,24 @@ class DecodeWorker:
                         pass
                     conn = None
                 registered = False
+                # next attempt tries the partition's next address (the
+                # warm standby when the primary is dead); wraps around so
+                # a recovered/readopted primary address is retried too
+                addr_idx += 1
                 self._sleep(backoff)
                 backoff = min(backoff * 2, 2.0)
+
+    def _beat_loop_finished(self) -> None:
+        """One partition's drain goodbye is done; the LAST loop to finish
+        marks the whole worker drained and stops it (the single-partition
+        behavior, generalized)."""
+        with self._beat_lock:
+            self._beat_loops_left -= 1
+            last = self._beat_loops_left <= 0
+        if last:
+            METRICS.count("service.worker_drained")
+            self.drained.set()
+            self.stop()
 
     # -- drain bookkeeping ---------------------------------------------------
 
@@ -884,6 +1441,7 @@ class DecodeWorker:
                 continue
             except OSError:
                 return
+            sp.enable_nodelay(conn)
             self._conns.track(conn)
             threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
@@ -1131,7 +1689,6 @@ class ServiceClient:
     def __init__(self, ds):
         opts = ds.options
         self._ds = ds
-        self.addr = opts.service
         self.deadline_s = (opts.service_deadline_ms or 5000.0) / 1000.0
         fb = opts.service_fallback_ms
         self.fallback_s = fb / 1000.0 if fb is not None else None
@@ -1142,6 +1699,17 @@ class ServiceClient:
         # the multi-tenant sharing key (decode fingerprint + shard list):
         # jobs that share it share one lease table and one warm cache
         self._tenant = self._spec["tenant"]
+        # the static partition map: this dataset's tenant hashes to ONE
+        # owning partition; the client speaks only to that partition's
+        # addresses (primary first), rotating to the standby on transport
+        # failure or a not_primary reply — failover is just the existing
+        # RetryPolicy backoff landing on the next address
+        pm = PartitionMap.parse(opts.service)
+        self.partition = pm.partition_for(self._tenant)
+        self._addrs = pm.addrs(self.partition)
+        self._addr_idx = 0
+        self.addr = self._addrs[0]
+        METRICS.gauge("service.partition", float(self.partition))
         # consumer identity for the dispatcher's per-tenant census only —
         # never part of any lease key
         self._consumer_id = (
@@ -1177,16 +1745,42 @@ class ServiceClient:
                 pass
             self._disp = None
 
+    def _rotate_addr(self) -> None:
+        """Advance to the owning partition's next address (primary ->
+        standby -> primary ...): the client-side half of failover. The
+        wrap-around matters — a promoted standby may have adopted the
+        dead primary's advertised address, so the old address is retried
+        too."""
+        if len(self._addrs) > 1:
+            self._addr_idx = (self._addr_idx + 1) % len(self._addrs)
+            self.addr = self._addrs[self._addr_idx]
+
     def _dispatcher_rpc(self, obj: Dict[str, Any]) -> Dict[str, Any]:
         if self._disp is None:
-            s = sp.connect(self.addr, timeout=self.deadline_s)
+            try:
+                s = sp.connect(self.addr, timeout=self.deadline_s)
+            except OSError:
+                # a refused/timed-out CONNECT must rotate too — otherwise
+                # a client whose current address is the dead primary
+                # retries that same corpse until its fallback budget
+                # dies, never reaching the promoted standby
+                self._rotate_addr()
+                raise
             s.settimeout(self.deadline_s)
             self._disp = s
         try:
-            return sp.request(self._disp, self.addr, obj)
+            reply = sp.request(self._disp, self.addr, obj)
         except (OSError, sp.ProtocolError):
             self.close()
+            self._rotate_addr()
             raise
+        if reply.get("error") == "not_primary":
+            # an honest standby (or demoted zombie): same retry shape as
+            # a dead dispatcher, but the next attempt must try the
+            # partition's other address
+            self.close()
+            self._rotate_addr()
+        return reply
 
     def _live_suspects(self) -> List[str]:
         now = self._clock()
@@ -1418,10 +2012,34 @@ def dispatcher_main(argv: List[str]) -> int:
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--journal", default=None,
-                    help="assignment journal path (atomic rewrite; a "
-                    "restarted dispatcher replays it)")
+                    help="assignment journal path (fsynced snapshot+delta "
+                    "lines; a restarted dispatcher replays it, a warm "
+                    "standby tails it)")
     ap.add_argument("--lease-ttl-s", type=float,
                     default=defaults.service_lease_ttl_s)
+    ap.add_argument("--standby-of", default=None, metavar="HOST:PORT",
+                    help="run as the warm standby of the primary at this "
+                    "address: tail its journal (--journal must point at "
+                    "the SAME file), detect death by ping loss, promote "
+                    "with a bumped generation (fencing the zombie) and "
+                    "take over the advertised address")
+    ap.add_argument("--partition", type=int, default=0,
+                    help="this dispatcher's index in the static partition "
+                    "map (consumers hash tenants over it)")
+    ap.add_argument("--generation", type=int, default=0,
+                    help="starting fencing generation (normally 0; the "
+                    "journal's replayed generation wins if higher)")
+    ap.add_argument("--takeover-misses", type=int,
+                    default=STANDBY_TAKEOVER_MISSES,
+                    help="consecutive failed primary pings before a "
+                    "standby promotes itself")
+    ap.add_argument("--ping-interval", type=float, default=None,
+                    help="standby ping/tail cadence in seconds (default "
+                    "min(1, lease_ttl/4))")
+    ap.add_argument("--no-addr-takeover", action="store_true",
+                    help="do not try to bind the dead primary's advertised "
+                    "address on promotion (clients rotate to the standby "
+                    "address via the partition map instead)")
     ap.add_argument("--elastic", action="store_true",
                     help="run a FleetScaler (tpu_tfrecord.elastic): spawn "
                     "decode-worker subprocesses on producer_bound, drain "
@@ -1447,14 +2065,25 @@ def dispatcher_main(argv: List[str]) -> int:
                     metavar="ARG", help="extra CLI arg for every spawned "
                     "worker (repeatable; e.g. --worker-arg=--cache "
                     "--worker-arg=auto)")
+    ap.add_argument("--partition-map", default=None, metavar="SPEC",
+                    help="full PartitionMap spec spawned workers register "
+                    "with (so every partition can route to them); default: "
+                    "just this dispatcher's address")
     _spool_args(ap)
     args = ap.parse_args(argv)
-    telemetry.adopt_from_env(role="dispatcher")
+    role = "standby" if args.standby_of else "dispatcher"
+    telemetry.adopt_from_env(role=role)
     d = ServiceDispatcher(
         port=args.port, host=args.host, journal=args.journal,
         lease_ttl_s=args.lease_ttl_s,
+        standby_of=args.standby_of,
+        partition_index=args.partition,
+        generation=args.generation,
+        takeover_misses=args.takeover_misses,
+        ping_interval_s=args.ping_interval,
+        takeover_addr=not args.no_addr_takeover,
     ).start()
-    spool = _maybe_spool(args, "dispatcher")
+    spool = _maybe_spool(args, role)
     scaler = None
     spawner = None
     if args.elastic:
@@ -1464,14 +2093,29 @@ def dispatcher_main(argv: List[str]) -> int:
         if scaler_spool is None:
             ap.error("--elastic needs --scaler-spool (or --spool-dir): the "
                      "scaler reads the cluster verdict from a spool dir")
-        spawner = elastic.subprocess_spawner(d.addr, tuple(args.worker_arg))
+        if args.standby_of:
+            ap.error("--elastic belongs on a PRIMARY: a standby must not "
+                     "run a second scaler over the same fleet")
+        spawner = elastic.subprocess_spawner(
+            args.partition_map or d.addr, tuple(args.worker_arg)
+        )
         max_workers = (
             args.max_workers
             if args.max_workers is not None
             else (defaults.elastic_max_workers or 8)
         )
+        # under a partition map the one scaler federates: this partition
+        # in-process, every other partition through a remote handle
+        targets: Any = d
+        if args.partition_map:
+            pmap = PartitionMap.parse(args.partition_map)
+            targets = [
+                d if i == args.partition
+                else elastic.DispatcherHandle(pmap.addrs(i))
+                for i in range(pmap.k)
+            ]
         scaler = elastic.FleetScaler(
-            d, spawner, spool_dir=scaler_spool,
+            targets, spawner, spool_dir=scaler_spool,
             policy=elastic.ScalerPolicy(
                 hysteresis=args.hysteresis, cooldown_s=args.cooldown,
                 min_workers=args.min_workers, max_workers=max_workers,
@@ -1482,8 +2126,11 @@ def dispatcher_main(argv: List[str]) -> int:
                 if args.scaler_roles else None
             ),
         ).start()
-    print(json.dumps({"event": "ready", "role": "dispatcher",
+    print(json.dumps({"event": "ready", "role": role,
                       "addr": d.addr, "pid": os.getpid(),
+                      "partition": d.partition_index,
+                      "generation": d.generation,
+                      "standby_of": args.standby_of,
                       "elastic": bool(scaler)}), flush=True)
     try:
         _run_forever(d._stop)
@@ -1504,7 +2151,10 @@ def worker_main(argv: List[str]) -> int:
     from tpu_tfrecord.options import TFRecordOptions
 
     ap = argparse.ArgumentParser(prog="tpu_tfrecord.service worker")
-    ap.add_argument("--dispatcher", required=True, help="dispatcher host:port")
+    ap.add_argument("--dispatcher", required=True,
+                    help="dispatcher address, or a full PartitionMap spec "
+                    "('h:p1|h:p2,h:p3' / '@map.json'): the worker "
+                    "registers with and heartbeats EVERY partition")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--worker-id", default=None)

@@ -105,6 +105,11 @@ COUNTERS: Dict[str, str] = {
     "service.cache_served": "worker shard streams served from warm cache",
     "service.tenants": "distinct dataset fingerprints served",
     "service.shared_cache_hits": "shard completions that rode another job's cache",
+    # -- HA: partitioned dispatchers + warm-standby failover
+    "service.failovers": "standby promotions to acting primary",
+    "service.fenced_writes": "journal appends rejected by the inode fence (zombie primary)",
+    "service.demotions": "primaries that stopped granting leases (journal failures / fenced)",
+    "service.not_primary_rejects": "lease-path ops refused by a standby or demoted primary",
     # -- elastic fleet scaler
     "elastic.scale_ups": "decode workers spawned by the scaler",
     "elastic.scale_downs": "drains initiated by the scaler",
@@ -113,6 +118,7 @@ COUNTERS: Dict[str, str] = {
     "elastic.spawn_errors": "worker spawns that failed",
     "elastic.step_errors": "scaler control-loop ticks that raised",
     "elastic.verdict_errors": "fleet verdict reads that failed (not idle)",
+    "elastic.census_errors": "scaler ticks skipped on an unreadable partition status",
     # -- training flight recorder
     "train.steps": "completed harness train steps",
     # -- async checkpointing (snapshot/commit split)
@@ -168,6 +174,7 @@ GAUGES: Dict[str, str] = {
     "write.occupancy": "EMA of writer slab-queue fill (write verdict input)",
     "write.inflight_slabs": "slabs in flight in the write pipeline",
     "elastic.workers": "decode worker processes the scaler believes live",
+    "service.partition": "partition index this process serves (or routes to)",
     "train.share.data_wait": "windowed share of step wall in data wait",
     "train.share.h2d": "windowed share of step wall in h2d",
     "train.share.compute": "windowed share of step wall in compute",
@@ -208,6 +215,8 @@ SPANS: Dict[str, str] = {
     "elastic.drain_complete": "a worker finished draining",
     "service.fallback": "a consumer degraded to local reads",
     "service.lease_reassigned": "an expired lease was re-routed",
+    "service.failover": "a standby took over a partition (or adopted its address)",
+    "service.demoted": "a primary stopped granting leases",
 }
 
 #: Prefixes under which names are formed at runtime and cannot be
