@@ -17,11 +17,26 @@ it into S×V interleaved pipeline chunks, and answer requests one
     `serve.requests` counter / `serve.latency` histogram feed the flight
     recorder like every other stage
 
+With ``--serve`` the example becomes a long-running serving REPLICA over
+the same checkpoint: the continuous-batching tier (tpu_tfrecord.serving)
+multiplexes concurrent socket clients onto the one compiled per-tick
+step, with admission control, per-request deadlines, and graceful drain —
+SIGTERM/SIGINT stops admitting, finishes every in-flight request, lands
+the telemetry spool's ``final: true`` snapshot, and exits 0. Read the
+replica with ``tools/tfrecord_doctor.py serve SPOOL_DIR``.
+
+The checkpoint read routes through the manifest-last restore path
+(``load_checkpoint`` below) in BOTH modes: a generation the trainer is
+still committing in the background has no manifest yet and is invisible,
+so serving can never half-read it.
+
 Run on any JAX backend; for a local simulation (after train_lm):
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python examples/train_lm.py --mesh dp_pp --steps 8
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python examples/serve_lm.py --pipe 2 --virtual 2
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/serve_lm.py --serve --spool-dir /tmp/serve_spool
 """
 
 import argparse
@@ -52,6 +67,26 @@ from tpu_tfrecord.tpu import create_mesh  # noqa: E402
 # the dp_pp trainer's depth (train_lm.pick_mesh): the checkpoint this
 # example loads carries 4 stacked blocks
 N_LAYERS = 4
+
+
+def load_checkpoint(ckpt_dir: str, template):
+    """The ONE serving-side checkpoint read: route through
+    ``LMCheckpoint.load`` — the manifest-last ``AsyncCheckpointer.restore``
+    — never the ``gen-*/`` directory layout directly. A generation the
+    trainer's background commit thread is still writing (or one a crash
+    left half-written) has no ``MANIFEST.json`` yet, so it is invisible
+    here and the newest COMPLETE generation is served instead; the
+    serving tier can never half-read a checkpoint. Pinned with the
+    checkpoint chaos park seam in tests/test_serving.py.
+
+    Returns ``(step, state)``; ``(None, template)`` when no complete
+    generation exists."""
+    ck = LMCheckpoint(ckpt_dir)
+    try:
+        step, state, _payload = ck.load(template)
+    finally:
+        ck.close()
+    return step, state
 
 
 def serve(stream: "lm.LMStream", requests) -> dict:
@@ -89,6 +124,30 @@ def main() -> None:
                     help="streamed microbatches to serve (timed pass)")
     ap.add_argument("--mb", type=int, default=8,
                     help="sequences per request microbatch")
+    ap.add_argument("--serve", action="store_true",
+                    help="run as a long-lived serving replica "
+                         "(continuous batching over sockets; graceful "
+                         "SIGTERM/SIGINT drain) instead of the one-shot "
+                         "timed pass")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="--serve: bind host")
+    ap.add_argument("--port", type=int, default=0,
+                    help="--serve: bind port (0 = ephemeral, printed on "
+                         "the ready line)")
+    ap.add_argument("--max-queue", type=int, default=16,
+                    help="--serve: admission queue bound (beyond it "
+                         "requests are shed with a Retry-After hint)")
+    ap.add_argument("--default-deadline-s", type=float, default=None,
+                    help="--serve: deadline applied to requests that "
+                         "carry none")
+    ap.add_argument("--slo-p99-ms", type=float, default=250.0,
+                    help="--serve: p99 target the status verdict is "
+                         "judged against")
+    ap.add_argument("--spool-dir", default=None,
+                    help="--serve: telemetry spool dir (read with "
+                         "tfrecord_doctor serve)")
+    ap.add_argument("--role", default="serving",
+                    help="--serve: telemetry role stamped on the spool")
     args = ap.parse_args()
 
     cfg = lm.LMConfig(
@@ -104,19 +163,44 @@ def main() -> None:
     # COMPLETE generation (manifest-last layout); the serving path wants
     # only the params half of the (params, opt) tuple
     template = lm.init_params(jax.random.key(0), cfg)
-    ck = LMCheckpoint(args.ckpt_dir)
     import optax
 
     tx = optax.adam(3e-3)
-    step, (params, _opt), _payload = ck.load((template, tx.init(template)))
-    ck.close()
+    step, (params, _opt) = load_checkpoint(
+        args.ckpt_dir, (template, tx.init(template))
+    )
     if step is None:
         print(f"no complete checkpoint generation in {args.ckpt_dir} — "
               f"run train_lm first", file=sys.stderr)
         sys.exit(1)
     params = jax.tree.map(np.asarray, params)
     print(f"serving checkpoint step {step} on pipe={args.pipe} "
-          f"virtual={args.virtual} mb={args.mb}")
+          f"virtual={args.virtual} mb={args.mb}",
+          file=sys.stderr if args.serve else sys.stdout)
+
+    if args.serve:
+        # replica mode: the overload-proof tier over this checkpoint.
+        # run_server owns the signal story — SIGTERM/SIGINT drains
+        # (stop admitting, finish in-flight, final spool snapshot) and
+        # returns 0; the ready line (addr + pid JSON) goes to stdout so
+        # spawners/scalers can find the ephemeral port
+        from tpu_tfrecord import serving
+
+        policy = serving.ServePolicy(
+            mb=args.mb, max_queue=args.max_queue,
+            default_deadline_s=args.default_deadline_s,
+            slo_p99_ms=args.slo_p99_ms,
+        )
+        engine = serving.ServingEngine(
+            params, cfg, mesh, pipe_axis="pipe", policy=policy
+        )
+        server = serving.ServeServer(
+            engine, host=args.host, port=args.port
+        ).start()
+        sys.exit(serving.run_server(
+            server, spool_dir=args.spool_dir, role=args.role,
+            ready_fh=sys.stdout,
+        ))
 
     stream = lm.LMStream(params, cfg, mesh, pipe_axis="pipe")
     reqs = [

@@ -159,6 +159,113 @@ class TestStreamParity:
             stream.push(np.zeros((2, 8), np.int32))
 
 
+class TestSlotIsolation:
+    """The property continuous batching (ISSUE 18) silently depends on:
+    a microbatch row ("slot") is a pure function of ITS OWN contents —
+    refilling one slot with a new request mid-flight must not perturb any
+    other slot's bytes at any tick."""
+
+    def test_changing_one_slot_perturbs_no_other_slot(self):
+        s, v, m, rows = 2, 2, 6, 4
+        mesh = create_mesh({"pipe": s}, jax.devices()[:s])
+        params, stage_fn = make_stages(s, n_virtual=v)
+        xs = np.random.default_rng(21).normal(size=(m, rows, 8)).astype(
+            np.float32
+        )
+        stream = pipeline.PipelineStream(stage_fn, params, mesh, n_virtual=v)
+        base = serve(stream, xs)
+        # "refill" slot 1 of microbatch 3 mid-flight: same schedule, one
+        # row's contents replaced
+        xs2 = xs.copy()
+        xs2[3, 1, :] = np.random.default_rng(99).normal(size=8).astype(
+            np.float32
+        )
+        stream.reset()
+        got = serve(stream, xs2)
+        assert len(got) == m
+        for i in range(m):
+            if i == 3:
+                continue
+            np.testing.assert_array_equal(
+                got[i], base[i],
+                err_msg=f"microbatch {i} perturbed by a slot refill in 3",
+            )
+        keep = [r for r in range(rows) if r != 1]
+        np.testing.assert_array_equal(
+            np.asarray(got[3])[keep], np.asarray(base[3])[keep],
+            err_msg="sibling slots perturbed by refilling slot 1",
+        )
+        assert not np.array_equal(got[3][1], base[3][1]), (
+            "the refilled slot must actually change (test is vacuous)"
+        )
+
+    def test_slot_outputs_invariant_to_row_position(self):
+        """A request's logits do not depend on WHICH slot it rides — the
+        scheduler may pack a continuation into any free row."""
+        s, v, rows = 2, 2, 4
+        mesh = create_mesh({"pipe": s}, jax.devices()[:s])
+        params, stage_fn = make_stages(s, n_virtual=v)
+        row = np.random.default_rng(5).normal(size=(1, 8)).astype(np.float32)
+        fill = np.zeros((rows, 8), np.float32)
+        outs = []
+        for slot in range(rows):
+            x = fill.copy()
+            x[slot] = row
+            stream = pipeline.PipelineStream(
+                stage_fn, params, mesh, n_virtual=v
+            )
+            (out,) = [*stream.push(x), *stream.flush()]
+            outs.append(np.asarray(out)[slot])
+        for slot in range(1, rows):
+            np.testing.assert_array_equal(outs[slot], outs[0])
+
+
+class TestTaggedStream:
+    """Host-side tag plumbing (ISSUE 18): tags ride the pending FIFO next
+    to their microbatch and pop with its output — they never enter the
+    compiled step (the argument-bytes pin above still holds)."""
+
+    def test_tags_pop_fifo_with_their_outputs(self):
+        s, v, m = 2, 2, 7
+        mesh = create_mesh({"pipe": s}, jax.devices()[:s])
+        params, stage_fn = make_stages(s, n_virtual=v)
+        xs = np.random.default_rng(7).normal(size=(m, 2, 8)).astype(
+            np.float32
+        )
+        stream = pipeline.PipelineStream(stage_fn, params, mesh, n_virtual=v)
+        got = []
+        for i in range(m):
+            got.extend(stream.push_tagged(xs[i], tag=("req", i)))
+        got.extend(stream.flush_tagged())
+        assert [t for _, t in got] == [("req", i) for i in range(m)]
+        ref = np.asarray(
+            pipeline.pipeline_apply(
+                stage_fn, params, jnp.asarray(xs), mesh, n_virtual=v
+            )
+        )
+        for i, (out, _) in enumerate(got):
+            np.testing.assert_array_equal(out, ref[i])
+
+    def test_untagged_push_unchanged(self):
+        """push/flush are exact unwraps of the tagged twins (default tag
+        None) — existing serving loops see identical outputs."""
+        mesh = create_mesh({"pipe": 2}, jax.devices()[:2])
+        params, stage_fn = make_stages(2)
+        xs = np.random.default_rng(3).normal(size=(4, 2, 8)).astype(
+            np.float32
+        )
+        stream = pipeline.PipelineStream(stage_fn, params, mesh)
+        plain = serve(stream, xs)
+        stream.reset()
+        tagged = []
+        for i in range(4):
+            tagged.extend(stream.push_tagged(xs[i]))
+        tagged.extend(stream.flush_tagged())
+        assert [t for _, t in tagged] == [None] * 4
+        for a, (b, _) in zip(plain, tagged):
+            np.testing.assert_array_equal(a, b)
+
+
 class TestStreamScaleShape:
     def test_per_call_feed_is_one_slice(self):
         """The no-[M, mb, ...]-materialization pin: the compiled step's

@@ -51,7 +51,7 @@ class ClockDisciplineRule(Rule):
     #: timing instrumentation (perf_counter spans) is out of scope.
     MODULES = {
         "autotune.py", "elastic.py", "retry.py", "stall.py", "fleet.py",
-        "service.py",
+        "service.py", "serving.py",
     }
     CALLS = {"time", "monotonic", "sleep"}
 

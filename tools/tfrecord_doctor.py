@@ -19,9 +19,11 @@ Usage::
     tools/tfrecord_doctor.py tune DATA_DIR                # offline autotune
     tools/tfrecord_doctor.py fleet SPOOL_DIR              # cluster doctor
     tools/tfrecord_doctor.py train SPOOL_DIR              # training doctor
+    tools/tfrecord_doctor.py serve SPOOL_DIR              # serving doctor
     tools/tfrecord_doctor.py merge-trace OUT F1 F2 ...    # fuse Perfetto traces
 
-``fleet``, ``train``, and ``serve-status`` accept ``--json``: the same
+``fleet``, ``train``, ``serve``, and ``serve-status`` accept ``--json``:
+the same
 event objects, in the same order, as ONE machine-readable JSON document
 ``{"events": [...]}`` instead of one object per line (exit codes
 unchanged — pinned by round-trip tests).
@@ -66,6 +68,18 @@ sums), cluster latency quantiles (exact histogram-bucket merges), the
 dead-process list, and the cluster verdict — "which worker is slow, which
 worker is DEAD, and is the fleet producer- or consumer-bound" answered
 from files alone, no live processes required.
+
+The ``serve`` subcommand is the SERVING doctor (tpu_tfrecord.serving):
+it reads the same spool directory as ``fleet`` but explains the
+continuous-batching tier — one ``{"event": "replica", ...}`` line per
+serving replica (request latency p50/p99, admission queue depth,
+in-flight slots, shed counts: rejected / deadline_expired / disconnects)
+and a final ``{"event": "serve", ...}`` line with exact merged latency
+quantiles, fleet shed totals, and the SLO verdict against ``--slo-ms``:
+``meeting_slo`` (p99 under target), ``queue_bound`` (missing SLO with a
+filling admission queue — add replicas), ``compute_bound`` (missing SLO
+with an empty queue — faster model/hardware, not more replicas). Exit
+0 = report (an overloaded tier is a finding), 2 = no serving spools.
 
 The ``serve-status`` subcommand is the data-service doctor
 (tpu_tfrecord.service): one status round trip to a dispatcher prints one
@@ -1043,6 +1057,167 @@ def _train_report(args, emit) -> int:
     return 0
 
 
+def serve_main(argv: List[str]) -> int:
+    """The ``serve`` subcommand: the serving-tier doctor. Reads the same
+    telemetry spool directory as ``fleet`` but explains the SERVING tier:
+    one ``{"event": "replica", ...}`` line per serving replica (request
+    latency p50/p99, admission queue depth, in-flight slots, shed counts,
+    per-replica SLO verdict) and one final ``{"event": "serve", ...}``
+    summary (exact merged latency quantiles, fleet shed totals, the SLO
+    verdict: ``meeting_slo`` / ``queue_bound`` / ``compute_bound``).
+    Exit 0 = report produced (an overloaded tier is a finding, not a
+    failure); 2 = unreadable spool dir or no serving spools in it."""
+    ap = argparse.ArgumentParser(
+        prog="tfrecord_doctor serve",
+        description="Serving doctor: latency SLO verdict for the "
+        "continuous-batching tier",
+    )
+    ap.add_argument("spool_dir", help="telemetry spool directory")
+    ap.add_argument(
+        "--stale-after", type=float, default=None, metavar="SECONDS",
+        help="heartbeat age beyond which a replica is dead "
+        "(default: 2x each process's own snapshot interval)",
+    )
+    ap.add_argument(
+        "--trace-id", default=None, metavar="ID",
+        help="only read spool files from this run",
+    )
+    ap.add_argument(
+        "--role", default="serving", metavar="ROLE",
+        help="telemetry role that marks a serving replica (default: "
+        "serving); processes with serve.ticks recorded qualify regardless",
+    )
+    ap.add_argument(
+        "--slo-ms", type=float, default=250.0, metavar="MS",
+        help="p99 latency target the verdict is judged against "
+        "(default: 250, the ServePolicy default)",
+    )
+    ap.add_argument(
+        "--max-queue", type=int, default=16, metavar="N",
+        help="per-replica admission queue bound used to call a replica "
+        "queue_bound (default: 16, the ServePolicy default)",
+    )
+    _add_json_flag(ap)
+    args = ap.parse_args(argv)
+
+    emit = _Emitter(args.json)
+    try:
+        return _serve_report(args, emit)
+    finally:
+        emit.close()
+
+
+# per-replica verdict ranking for the fleet line: the fleet is as sick as
+# its sickest replica, and queue_bound (shedding work) outranks
+# compute_bound (slow but keeping up) — same ordering ServingScaler uses
+_SERVE_VERDICT_RANK = {"meeting_slo": 1, "compute_bound": 2, "queue_bound": 3}
+
+
+def _serve_report(args, emit) -> int:
+    from tpu_tfrecord import fleet, telemetry
+    from tpu_tfrecord.telemetry import Histogram
+
+    try:
+        agg = fleet.TelemetryAggregator(
+            args.spool_dir, stale_after_s=args.stale_after,
+            trace_id=args.trace_id,
+        )
+        snap = agg.aggregate()
+    except Exception as e:  # graftlint: swallow(error event emitted + exit 2)
+        emit({"event": "error", "path": args.spool_dir, "error": str(e)})
+        return 2
+    procs = snap.processes
+    dead_ids = {id(p) for p in snap.dead}
+    # a replica is anything stamped with the serving role OR anything that
+    # recorded scheduler ticks (a custom-role embedder still gets judged)
+    replicas = [
+        p for p in procs
+        if p.role == args.role or "serve.ticks" in p.counters
+    ]
+    if not replicas:
+        emit({
+            "event": "error", "path": args.spool_dir,
+            "error": (
+                f"no serving spools found ({len(procs)} spool files, "
+                f"roles: {sorted({p.role for p in procs})})"
+                if procs else "no spool files found"
+            ),
+        })
+        return 2
+    now = agg._clock()
+    merged_latency = Histogram()
+    fleet_queue = 0.0
+    shed_total = {"rejected": 0, "deadline_expired": 0, "disconnects": 0}
+    worst = "unknown"
+    for p in replicas:
+        queue_depth = p.gauges.get("serve.queue_depth", 0.0)
+        fleet_queue += queue_depth
+        sheds = {
+            k: p.counters.get("serve." + k, 0)
+            for k in ("rejected", "deadline_expired", "disconnects")
+        }
+        for k, v in sheds.items():
+            shed_total[k] += v
+        wall = p.heartbeat - p.created if p.created else 0.0
+        completed = p.counters.get("serve.requests", 0)
+        line: Dict = {
+            "event": "replica",
+            "host": p.host,
+            "pid": p.pid,
+            "role": p.role,
+            "alive": id(p) not in dead_ids,
+            **({"finished": True} if p.final else {}),
+            "heartbeat_age_s": round(p.heartbeat_age(now), 3),
+            "requests": completed,
+            "requests_per_sec": (
+                round(completed / wall, 3) if completed and wall > 0 else None
+            ),
+            "queue_depth": round(queue_depth, 1),
+            "in_flight": round(p.gauges.get("serve.in_flight", 0.0), 1),
+            "sheds": sheds,
+        }
+        p99_ms = None
+        lat_state = p.hists.get("serve.latency")
+        if lat_state:
+            try:
+                h = Histogram.from_states([lat_state])
+                merged_latency.merge_state(lat_state)
+                q = h.quantiles()
+                line["latency_p50_ms"] = round(q["p50_s"] * 1e3, 3)
+                p99_ms = round(q["p99_s"] * 1e3, 3)
+                line["latency_p99_ms"] = p99_ms
+            except (ValueError, TypeError, KeyError, IndexError):
+                pass  # one replica's corrupt hist loses its quantiles only
+        verdict = telemetry.serving_verdict(
+            p99_ms, queue_depth, args.slo_ms, max_queue=args.max_queue,
+        )
+        line["verdict"] = verdict
+        if p.skipped_lines:
+            line["skipped_lines"] = p.skipped_lines
+        emit(line)
+        if _SERVE_VERDICT_RANK.get(verdict, 0) > _SERVE_VERDICT_RANK.get(
+            worst, 0
+        ):
+            worst = verdict
+    summary: Dict = {
+        "event": "serve",
+        "path": args.spool_dir,
+        "replicas": len(replicas),
+        "requests": sum(p.counters.get("serve.requests", 0) for p in replicas),
+        "queue_depth": round(fleet_queue, 1),
+        "sheds": shed_total,
+        "slo_p99_ms": args.slo_ms,
+        "verdict": worst,
+        "trace_ids": sorted({p.trace_id for p in replicas if p.trace_id}),
+    }
+    if merged_latency.count:
+        q = merged_latency.quantiles()
+        summary["latency_p50_ms"] = round(q["p50_s"] * 1e3, 3)
+        summary["latency_p99_ms"] = round(q["p99_s"] * 1e3, 3)
+    emit(summary)
+    return 0
+
+
 def merge_trace_main(argv: List[str]) -> int:
     """The ``merge-trace`` subcommand: fuse per-process Chrome traces into
     one Perfetto timeline. Exit 0 = merged; 2 = unreadable/malformed input."""
@@ -1171,6 +1346,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return fleet_main(argv[1:])
     if argv and argv[0] == "train":
         return train_main(argv[1:])
+    if argv and argv[0] == "serve":
+        return serve_main(argv[1:])
     if argv and argv[0] == "serve-status":
         return serve_status_main(argv[1:])
     if argv and argv[0] == "merge-trace":

@@ -714,6 +714,155 @@ print("serving smoke OK:", json.dumps({
 }))
 PY
 
+echo "== serving-tier smoke (subprocess replica + injected disconnect -> 4 concurrent clients byte-identical to sequential; doctor serve verdict) =="
+# ISSUE 18 end-to-end: one synthetic-model replica in its own process
+# with a seeded op='serve' client_disconnect fault armed on the reply
+# seam. 4 concurrent ServeClients multiplex onto the continuous-batching
+# engine; the victim's connection is dropped mid-exchange and its client
+# reconnects and resends (deterministic model => same bytes). Every
+# client's output must be byte-identical to a one-at-a-time
+# sequential_reference run, SIGTERM must drain gracefully (exit 0, final
+# spool snapshot), and `tfrecord_doctor serve` on the spool must exit 0
+# with the disconnect counted and a verdict.
+env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python - <<'PY' || exit 1
+import json, os, signal, subprocess, sys, tempfile, threading, time
+
+import numpy as np
+
+root = tempfile.mkdtemp(prefix="tfr_serve_tier_smoke_")
+spool = os.path.join(root, "spool")
+plan_path = os.path.join(root, "plan.json")
+from tpu_tfrecord import faults
+plan = faults.FaultPlan([
+    faults.FaultRule(op="serve", kind="client_disconnect",
+                     path="reply:", times=1),
+])
+with open(plan_path, "w") as fh:
+    json.dump(plan.to_json(), fh)
+
+srv = subprocess.Popen(
+    [sys.executable, "-m", "tpu_tfrecord.serving", "--seed", "0",
+     "--spool-dir", spool, "--fault-plan", plan_path],
+    stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+)
+try:
+    ready = json.loads(srv.stdout.readline())
+    addr = ready["addr"]
+
+    rng = np.random.default_rng(7)
+    windows = [
+        rng.integers(1, 96, size=16).astype(np.int32) for _ in range(5)
+    ]
+
+    from tpu_tfrecord import service_protocol as sp
+    from tpu_tfrecord.serving import ServeClient
+
+    # phase 1 — the 4 concurrent clients, injected chaos armed: the
+    # FIRST reply written on any connection is killed (times=1), so
+    # exactly one client loses a completed reply and its retry policy
+    # resends (the +1 in the doctor's request count below)
+    results, errors = {}, []
+
+    def client(i):
+        c = ServeClient([addr])
+        try:
+            results[i] = c.generate(windows[i], n_new=3)
+        except Exception as e:  # noqa: BLE001
+            errors.append((i, repr(e)))
+        finally:
+            c.close()
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    assert sorted(results) == [0, 1, 2, 3], sorted(results)
+
+    # phase 2 — a doomed raw-socket client (the injected fault is spent,
+    # so status replies are safe now): long request, hang up the moment
+    # the engine has it in flight — the dropped slot must free (counted
+    # serve.disconnects) and the replica must still drain cleanly
+    doomed = sp.connect(addr, timeout=30.0)
+    sp.send_msg(doomed, {
+        "v": sp.PROTO_VERSION, "op": "generate", "req": 1,
+        "tokens": windows[4].tolist(), "n_new": 500, "deadline_s": None,
+    })
+    probe = sp.connect(addr, timeout=30.0)
+    deadline = time.monotonic() + 60
+    while True:
+        st = sp.request(probe, addr, {
+            "v": sp.PROTO_VERSION, "op": "status", "req": 1,
+        })
+        if st["in_flight"] >= 1:
+            break
+        assert time.monotonic() < deadline, st
+        time.sleep(0.02)
+    doomed.close()
+    # the freed slot: in_flight drains back to 0 before the goodbye
+    deadline = time.monotonic() + 60
+    while True:
+        st = sp.request(probe, addr, {
+            "v": sp.PROTO_VERSION, "op": "status", "req": 2,
+        })
+        if st["in_flight"] == 0 and st["queue_depth"] == 0:
+            break
+        assert time.monotonic() < deadline, st
+        time.sleep(0.05)
+    assert st["counters"].get("serve.disconnects", 0) >= 1, st
+    probe.close()
+
+    # the local reference: same seed 0 => same params => exact bytes
+    import jax
+    from tpu_tfrecord.models import lm
+    from tpu_tfrecord.serving import sequential_reference
+    from tpu_tfrecord.tpu import create_mesh
+    cfg = lm.LMConfig(vocab_size=96, d_model=32, n_heads=2, n_layers=4,
+                      max_len=16, n_micro=4, n_virtual=1)
+    params = lm.init_params(jax.random.key(0), cfg)
+    mesh = create_mesh({"pipe": 2}, jax.devices()[:2])
+    ref = sequential_reference(
+        params, cfg, mesh, [(w, 3) for w in windows], 4
+    )
+    for i in range(4):
+        assert results[i] == ref[i], (i, results[i], ref[i])
+
+    srv.send_signal(signal.SIGTERM)  # graceful drain
+    out, err = srv.communicate(timeout=60)
+    assert srv.returncode == 0, (srv.returncode, out[-2000:], err[-2000:])
+finally:
+    if srv.poll() is None:
+        srv.kill()
+        srv.wait()
+
+doc = subprocess.run(
+    [sys.executable, "tools/tfrecord_doctor.py", "serve", spool, "--json"],
+    capture_output=True, text=True, timeout=120,
+)
+assert doc.returncode == 0, (doc.returncode, doc.stdout, doc.stderr)
+events = json.loads(doc.stdout)["events"]
+summary = [e for e in events if e["event"] == "serve"][-1]
+# 5 completed requests: 4 clients + ONE resend — the injected reply-seam
+# disconnect killed exactly one completed reply and that client's retry
+# policy resent it (deterministic model => same bytes). The doomed raw
+# client's mid-generation hangup is the counted disconnect; the injected
+# one dropped a COMPLETED request's reply, which is a resend, not lost
+# work.
+assert summary["requests"] == 5, summary
+assert summary["sheds"]["disconnects"] >= 1, summary
+assert summary["verdict"] in (
+    "meeting_slo", "compute_bound", "queue_bound", "unknown"
+), summary
+print("serving-tier smoke OK:", json.dumps({
+    "byte_identical": True,
+    "disconnects": summary["sheds"]["disconnects"],
+    "verdict": summary["verdict"],
+    "latency_p99_ms": summary.get("latency_p99_ms"),
+}))
+PY
+
 echo "== async-ckpt smoke (seeded slow disk, SIGKILL mid-commit -> resume from complete generation, non-ckpt_bound) =="
 # ISSUE 16 end-to-end: train_lm under a seeded commit throttle (the
 # slow-disk fault). The kill leg SIGKILLs right after step 9 — the step-8

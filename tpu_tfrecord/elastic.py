@@ -71,6 +71,8 @@ from tpu_tfrecord.metrics import METRICS, logger
 __all__ = [
     "ScalerPolicy",
     "FleetScaler",
+    "ServingScaler",
+    "ServingReplicaSpawner",
     "DispatcherHandle",
     "SubprocessSpawner",
     "subprocess_spawner",
@@ -560,3 +562,347 @@ def subprocess_spawner(
     env: Optional[Dict[str, str]] = None,
 ) -> SubprocessSpawner:
     return SubprocessSpawner(dispatcher_addr, extra_args, env=env)
+
+
+# ---------------------------------------------------------------------------
+# Serving role (ISSUE 18): replicas scale on queue-depth/p99
+# ---------------------------------------------------------------------------
+
+
+def _serving_status_rpc(addr: str, timeout: float = 5.0) -> Dict[str, Any]:
+    from tpu_tfrecord import service_protocol as sp
+
+    sock = sp.connect(addr, timeout=timeout)
+    try:
+        sock.settimeout(timeout)
+        return sp.request(
+            sock, addr, {"v": sp.PROTO_VERSION, "op": "status", "req": 0}
+        )
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def _serving_drain_rpc(addr: str, timeout: float = 5.0) -> Dict[str, Any]:
+    from tpu_tfrecord import service_protocol as sp
+
+    sock = sp.connect(addr, timeout=timeout)
+    try:
+        sock.settimeout(timeout)
+        return sp.request(
+            sock, addr, {"v": sp.PROTO_VERSION, "op": "drain", "req": 0}
+        )
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+class ServingScaler:
+    """The serving-role twin of :class:`FleetScaler` (ISSUE 18): scales
+    inference REPLICAS (``tpu_tfrecord.serving`` servers) on
+    queue-depth/p99 the way the decode fleet scales on producer_bound.
+
+    - **Sensor**: each replica's ``status`` RPC (queue depth, in-flight,
+      per-request p99, completion counter). The fleet verdict is the
+      worst replica's `telemetry.serving_verdict` — ``queue_bound``
+      (requests queue faster than slots free: add a replica), or —
+      when every replica is empty AND no request completed since the
+      last tick — ``idle`` (drain one). ``meeting_slo``/
+      ``compute_bound`` hold the size: more replicas cannot speed up
+      the compiled step itself.
+    - **Actuator**: ``spawn()`` must launch a replica and return its
+      address once it is ready to serve (the SubprocessServingSpawner
+      shape: block on the child's ready line). Scale-down picks the
+      LAST active address in sorted order and sends the ``drain`` RPC:
+      the replica stops admitting, finishes in-flight requests, lands
+      its ``final: true`` spool snapshot, and exits; its disappearance
+      retires it from the member list (``elastic.drains``).
+    - **Guard rails**: the same ``BoundedClimber`` hysteresis/cooldown.
+      A replica that stops answering WITHOUT having been drained — a
+      SIGKILL — is dropped from the membership immediately, and the
+      ``min_workers`` floor refills it outside the climber (the same
+      below-floor bypass the decode fleet uses); meanwhile clients walk
+      the member list, so the dead replica's queue drains through the
+      survivors.
+
+    ``step()`` is one decision tick (drive it directly with an injected
+    clock in tests); ``start()`` runs the production thread.
+    """
+
+    def __init__(
+        self,
+        spawn: Callable[[], str],
+        replicas: Optional[List[str]] = None,
+        policy: Optional[ScalerPolicy] = None,
+        interval_s: float = DEFAULT_INTERVAL_S,
+        status_fn: Callable[[str], Dict[str, Any]] = _serving_status_rpc,
+        drain_fn: Callable[[str], Dict[str, Any]] = _serving_drain_rpc,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.spawn = spawn
+        self.replicas: List[str] = list(replicas or [])
+        self.policy = policy or ScalerPolicy()
+        self.interval_s = float(interval_s)
+        self._status = status_fn
+        self._drain = drain_fn
+        self.clock = clock
+        self._climber = BoundedClimber(
+            self.policy.hysteresis,
+            self.policy.cooldown_s,
+            clock=clock,
+            actionable=("queue_bound", "idle"),
+        )
+        self.log: List[Dict[str, Any]] = []
+        self.last_decision: Optional[Dict[str, Any]] = None
+        self._tick = 0
+        self._draining: set = set()
+        self._last_completed: Optional[int] = None
+        self._last_verdict: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- census ----------------------------------------------------------------
+
+    def _census(self) -> Dict[str, Any]:
+        """Poll every member: active statuses, replicas mid-drain, and —
+        unlike the decode fleet's partition census — DEAD members, which
+        are actionable here: a drained replica saying goodbye retires
+        cleanly (``elastic.drains``) while an undrained death is a kill
+        the floor check must refill."""
+        statuses: Dict[str, Dict[str, Any]] = {}
+        for addr in list(self.replicas):
+            try:
+                st = self._status(addr)
+            except (OSError, RuntimeError) as e:
+                self.replicas.remove(addr)
+                if addr in self._draining:
+                    self._draining.discard(addr)
+                    METRICS.count("elastic.drains")
+                else:
+                    METRICS.count("elastic.replicas_lost")
+                    logger.warning(
+                        "tfrecord.elastic serving replica %s lost "
+                        "undrained: %s", addr, e
+                    )
+                continue
+            statuses[addr] = st
+            if st.get("draining"):
+                self._draining.add(addr)
+        active = sorted(a for a in statuses if a not in self._draining)
+        return {"active": active, "statuses": statuses}
+
+    def _verdict(self, census: Dict[str, Any]) -> str:
+        """Worst replica wins; idleness needs BOTH empty queues and zero
+        completions since the last tick (a fleet at exactly its capacity
+        has empty queues between bursts — that is not idle)."""
+        active = census["active"]
+        if not active:
+            return "unknown"
+        statuses = [census["statuses"][a] for a in active]
+        completed = sum(int(s.get("completed") or 0) for s in statuses)
+        delta = (
+            None if self._last_completed is None
+            else completed - self._last_completed
+        )
+        self._last_completed = completed
+        backlog = sum(
+            int(s.get("queue_depth") or 0) + int(s.get("in_flight") or 0)
+            for s in statuses
+        )
+        if backlog == 0 and delta == 0:
+            return "idle"
+        worst = "unknown"
+        rank = {"meeting_slo": 1, "compute_bound": 2, "queue_bound": 3}
+        for s in statuses:
+            v = telemetry.serving_verdict(
+                s.get("p99_ms"), s.get("queue_depth"),
+                float(s.get("slo_p99_ms") or 0.0) or 250.0,
+                max_queue=int(s.get("max_queue") or 16),
+            )
+            if rank.get(v, 0) > rank.get(worst, 0):
+                worst = v
+        return worst
+
+    # -- the decision tick -----------------------------------------------------
+
+    def step(self) -> Optional[Dict[str, Any]]:
+        """One control step: census, verdict, at most one fleet move.
+        Below-floor refill (dead replica) bypasses the climber — a
+        SIGKILLed replica is replaced on the next tick, not after
+        ``hysteresis`` of them."""
+        self._tick += 1
+        pol = self.policy
+        census = self._census()
+        active = census["active"]
+        verdict = self._verdict(census)
+        self._last_verdict = verdict
+        decision: Optional[Dict[str, Any]] = None
+        if len(active) < pol.min_workers:
+            decision = self._spawn_one(len(active), "below_min")
+        else:
+            act = self._climber.observe(verdict)
+            if act == "queue_bound" and len(active) < pol.max_workers:
+                decision = self._spawn_one(len(active), act)
+                if decision is not None:
+                    self._climber.acted()
+            elif act == "idle" and len(active) > pol.min_workers:
+                decision = self._drain_one(active, act)
+                if decision is not None:
+                    self._climber.acted()
+        METRICS.gauge("elastic.replicas", float(len(census["active"])))
+        return decision
+
+    def _spawn_one(self, n: int, reason: str) -> Optional[Dict[str, Any]]:
+        try:
+            addr = self.spawn()
+        except Exception as e:  # noqa: BLE001 — a failed exec must not
+            # kill the control loop; the next tick retries
+            METRICS.count("elastic.spawn_errors")
+            logger.warning("tfrecord.elastic serving spawn failed: %s", e)
+            return None
+        self.replicas.append(str(addr))
+        METRICS.count("elastic.scale_ups")
+        return self._record("scale_up", reason,
+                            {"replicas": n, "target": n + 1,
+                             "addr": str(addr)})
+
+    def _drain_one(self, active: List[str], reason: str) -> Optional[Dict[str, Any]]:
+        victim = active[-1]
+        try:
+            self._drain(victim)
+        except OSError as e:
+            logger.warning(
+                "tfrecord.elastic drain of serving replica %s failed: %s",
+                victim, e,
+            )
+            return None
+        self._draining.add(victim)
+        METRICS.count("elastic.scale_downs")
+        return self._record("scale_down", reason,
+                            {"replicas": len(active),
+                             "target": len(active) - 1, "victim": victim})
+
+    def _record(self, action: str, reason: str, extra: Dict[str, Any]) -> Dict[str, Any]:
+        decision = {"tick": self._tick, "action": action, "reason": reason,
+                    **extra}
+        self.log.append(decision)
+        self.last_decision = decision
+        telemetry.instant("elastic.decision", action=action, reason=reason)
+        return decision
+
+    def status(self) -> Dict[str, Any]:
+        return {
+            "replicas": list(self.replicas),
+            "draining": sorted(self._draining),
+            "min_workers": self.policy.min_workers,
+            "max_workers": self.policy.max_workers,
+            "verdict": self._last_verdict,
+            "last_decision": self.last_decision,
+            "scale_ups": METRICS.counter("elastic.scale_ups"),
+            "scale_downs": METRICS.counter("elastic.scale_downs"),
+            "drains_completed": METRICS.counter("elastic.drains"),
+        }
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> "ServingScaler":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="tfr-serving-scaler"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._thread = None
+
+    def __enter__(self) -> "ServingScaler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.step()
+            except Exception as e:  # noqa: BLE001 — the control loop is
+                # telemetry-adjacent: it must never die silently mid-fleet
+                METRICS.count("elastic.step_errors")
+                logger.warning(
+                    "tfrecord.elastic serving step failed: %s", e
+                )
+
+
+class ServingReplicaSpawner:
+    """The production serving ``spawn``: each call launches one
+    ``python -m tpu_tfrecord.serving`` replica (synthetic model, seeded
+    — the chaos/scale harness shape) with the given CLI args, BLOCKS on
+    its ready line, and returns the replica's address — exactly what
+    :class:`ServingScaler` appends to its member list. ``reap()`` is the
+    shutdown safety net for replicas still alive (a drained replica
+    exits on its own)."""
+
+    def __init__(
+        self,
+        extra_args: tuple = (),
+        env: Optional[Dict[str, str]] = None,
+    ):
+        self.extra_args = tuple(str(a) for a in extra_args)
+        self.env = dict(env) if env is not None else None
+        self.procs: List[Any] = []
+        self._lock = threading.Lock()
+
+    def __call__(self) -> str:
+        import json as _json
+        import subprocess
+        import sys
+
+        env = dict(self.env) if self.env is not None else dict(os.environ)
+        pkg_parent = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        env["PYTHONPATH"] = (
+            pkg_parent + os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else pkg_parent
+        )
+        p = subprocess.Popen(
+            [sys.executable, "-m", "tpu_tfrecord.serving", *self.extra_args],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+            env=env,
+        )
+        with self._lock:
+            self.procs.append(p)
+        line = p.stdout.readline()
+        if not line:
+            raise OSError("serving replica died before its ready line")
+        return str(_json.loads(line)["addr"])
+
+    def reap(self, timeout: float = 10.0) -> None:
+        with self._lock:
+            procs = list(self.procs)
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=timeout)
+                except Exception:  # noqa: BLE001  # graftlint: swallow(best-effort shutdown reap; kill() fallback follows)
+                    try:
+                        p.kill()
+                    except OSError:
+                        pass

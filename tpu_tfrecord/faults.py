@@ -75,6 +75,14 @@ FAULT_KINDS = (
     "http_error",  # `status` (503/429/...) response, Retry-After honored
     "bad_content_range",  # serve range start+shift_bytes, honestly labeled
     "trickle",  # body dribbled cap_bytes per stall_ms — slow-trickle stall
+    # Serving-tier kinds (op="serve"), executed by tpu_tfrecord.serving
+    # at its reply/recv/load seams (ISSUE 18 chaos certification):
+    "slow_client",  # stall the server's reply to ONE client for stall_ms
+    # — must block only that client's writer, never the engine tick
+    "client_disconnect",  # drop the client's connection mid-generation —
+    # the request's slot must free without perturbing neighbors' bytes
+    "burst",  # the open-loop load generator injects burst_n extra
+    # requests at the matching call — the overload-shedding scenario
 )
 
 #: ops a rule may target. ``read`` covers read()/readinto() on handles the
@@ -103,8 +111,15 @@ FAULT_KINDS = (
 #: crash-mid-append tear standby replay must absorb), ``sigkill`` kills
 #: the dispatcher process at the write, and transient/permanent errors
 #: exercise the journal-failure self-demotion path.
+#: ``serve`` is the serving tier's seam (tpu_tfrecord.serving): the path
+#: a rule matches is the seam point — ``reply:<peer>`` (the server's
+#: per-client writer, where ``slow_client`` stalls and
+#: ``client_disconnect`` drops the connection), ``recv:<peer>`` (the
+#: server's per-client reader, same kinds), and ``load`` (the open-loop
+#: generator's admission call, where ``burst`` injects burst_n extra
+#: requests). All on the same replayable ledger as the file/socket seams.
 FAULT_OPS = ("open", "read", "rename", "listdir", "connect", "recv", "http",
-             "journal")
+             "journal", "serve")
 
 #: kinds only the fault-injecting HTTP server executes (op="http").
 HTTP_ONLY_KINDS = (
@@ -119,6 +134,25 @@ HTTP_ONLY_KINDS = (
 HTTP_ALLOWED_KINDS = HTTP_ONLY_KINDS + (
     "stall", "transient_error", "permanent_error",
 )
+
+#: kinds only the serving tier executes (op="serve").
+SERVE_ONLY_KINDS = ("slow_client", "client_disconnect", "burst")
+
+#: every kind an ``op="serve"`` rule may carry — serve-only kinds plus the
+#: generic ones the serving seams actually execute. Anything else would be
+#: ledgered as fired while the server behaves clean (the silent no-op the
+#: http vocabulary check already refuses).
+SERVE_ALLOWED_KINDS = SERVE_ONLY_KINDS + (
+    "stall", "transient_error", "permanent_error",
+)
+
+
+#: set by install_chaos; the serving tier (tpu_tfrecord.serving) consults
+#: it at its reply/recv/load seams. Lives HERE rather than in serving.py
+#: so installing chaos never has to import the jax-heavy serving module
+#: (and works regardless of import order); an explicit ``fault_plan``
+#: passed to a server/load-generator wins over this global.
+_SERVE_CHAOS: Optional["FaultPlan"] = None
 
 
 class InjectedFault(OSError):
@@ -146,6 +180,7 @@ class FaultRule:
     status: int = 503  # http_error response code (429/503/...)
     retry_after_s: float = 0.0  # Retry-After header on http_error responses
     shift_bytes: int = 64  # bad_content_range: how far the server lies
+    burst_n: int = 0  # burst: extra requests the load generator injects
 
     def __post_init__(self) -> None:
         if self.op not in FAULT_OPS:
@@ -190,6 +225,21 @@ class FaultRule:
                                  "many record bytes land before the tear)")
         if self.kind == "netsplit" and self.op not in ("connect", "recv"):
             raise ValueError("netsplit requires op='connect' or op='recv'")
+        if self.kind in SERVE_ONLY_KINDS and self.op != "serve":
+            # these describe serving-tier behavior (a client's half of a
+            # request/reply stream, an admission burst); on any other op
+            # they would ledger as fired and do nothing
+            raise ValueError(f"kind {self.kind!r} requires op='serve'")
+        if self.op == "serve" and self.kind not in SERVE_ALLOWED_KINDS:
+            raise ValueError(
+                f"op='serve' supports kinds {SERVE_ALLOWED_KINDS}, got "
+                f"{self.kind!r} — the serving seams would ledger it as "
+                "fired while serving clean"
+            )
+        if self.kind == "slow_client" and self.stall_ms <= 0:
+            raise ValueError("slow_client requires stall_ms > 0")
+        if self.kind == "burst" and self.burst_n < 1:
+            raise ValueError("burst requires burst_n >= 1")
 
     def matches_path(self, path: str) -> bool:
         return self.path in path
@@ -279,8 +329,10 @@ class FaultPlan:
                     "ordinal": n,
                     "kind": rule.kind,
                 }
-                if rule.kind in ("stall", "trickle"):
+                if rule.kind in ("stall", "trickle", "slow_client"):
                     entry["stall_ms"] = rule.stall_ms
+                if rule.kind == "burst":
+                    entry["burst_n"] = rule.burst_n
                 if rule.kind in ("short_read", "torn_write"):
                     entry["cap_bytes"] = rule.cap_bytes
                 if rule.kind == "http_error":
@@ -370,6 +422,33 @@ class FaultPlan:
                 # are different scenarios worth telling apart in a replay
                 self._raise_for(fault)
         return cap
+
+    def apply_serve(self, point: str, sock=None) -> int:
+        """Run the plan for one serving-tier call (``op="serve"`` against
+        the seam point — ``reply:<peer>``, ``recv:<peer>``, or ``load``):
+        ``slow_client``/``stall`` sleep (the stuck-client scenario, as the
+        server's per-client writer observes it), ``client_disconnect``
+        CLOSES the peer socket and raises (the mid-generation hangup whose
+        slot must free without perturbing neighbors), errors raise, and
+        ``burst`` returns how many EXTRA requests the open-loop generator
+        must inject at this call (summed across fired rules, 0 = none)."""
+        burst = 0
+        for fault in self.decide("serve", point):
+            kind = fault["kind"]
+            if kind in ("stall", "slow_client"):
+                self.sleep(fault["_rule"].stall_ms / 1000.0)
+            elif kind == "client_disconnect":
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                self._raise_for(fault)
+            elif kind == "burst":
+                burst += fault["_rule"].burst_n
+            else:
+                self._raise_for(fault)
+        return burst
 
     def apply_journal(self, path: str, data: bytes) -> None:
         """Run the plan for one dispatcher-journal write (``op="journal"``
@@ -511,6 +590,8 @@ def install_chaos(plan: FaultPlan):
     orig_chaos_plan = _sp._CHAOS_PLAN
     orig_http_plan = _httpfs._CHAOS_PLAN
     orig_journal_plan = _service._JOURNAL_CHAOS
+    global _SERVE_CHAOS
+    orig_serve_plan = _SERVE_CHAOS
 
     def chaos_filesystem_for(path: str):
         return ChaosFS(orig_filesystem_for(path), plan)
@@ -533,6 +614,9 @@ def install_chaos(plan: FaultPlan):
     # the dispatcher-journal write seam: every journal append/compaction
     # consults the plan under op="journal" (torn_write / sigkill / errors)
     _service._JOURNAL_CHAOS = plan
+    # the serving-tier seam (tpu_tfrecord.serving reads this module's
+    # global at its reply/recv/load points — op="serve" rules)
+    _SERVE_CHAOS = plan
     try:
         yield plan
     finally:
@@ -542,4 +626,5 @@ def install_chaos(plan: FaultPlan):
         _sp._CHAOS_PLAN = orig_chaos_plan
         _httpfs._CHAOS_PLAN = orig_http_plan
         _service._JOURNAL_CHAOS = orig_journal_plan
+        _SERVE_CHAOS = orig_serve_plan
         plan.release()

@@ -501,15 +501,29 @@ class LMStream:
         """One request: tokens [mb, L+1] int32 in, zero or more finished
         [mb, L, V] f32 logits out (FIFO — outputs lag by the pipeline's
         S·V-tick latency)."""
+        return [out for out, _ in self.submit_tagged(tokens)]
+
+    def submit_tagged(self, tokens, tag=None) -> list:
+        """`submit` riding an opaque host-side tag on the microbatch (see
+        `PipelineStream.push_tagged`); returns ``(logits, tag)`` pairs so
+        a multiplexer can map each popped [mb, L, V] back to the requests
+        packed into its slots. The tag stays on the host — the compiled
+        step and its argument bytes are untouched."""
         x = self._embed(self._ep, jnp.asarray(tokens))
         return [
-            np.asarray(self._head(self._hp, o)) for o in self.stream.push(x)
+            (np.asarray(self._head(self._hp, o)), t)
+            for o, t in self.stream.push_tagged(x, tag)
         ]
 
     def flush(self) -> list:
         """Drain the in-flight tail; returns the remaining logits FIFO."""
+        return [out for out, _ in self.flush_tagged()]
+
+    def flush_tagged(self) -> list:
+        """`flush` returning ``(logits, tag)`` pairs (see `submit_tagged`)."""
         return [
-            np.asarray(self._head(self._hp, o)) for o in self.stream.flush()
+            (np.asarray(self._head(self._hp, o)), t)
+            for o, t in self.stream.flush_tagged()
         ]
 
     def reset(self) -> None:
@@ -530,6 +544,26 @@ class LMStream:
             np.asarray(self._head(self._hp, out[i]))
             for i in range(len(batches))
         ]
+
+
+def pack_slots(windows, mb: int, max_len: int) -> np.ndarray:
+    """Pack up to ``mb`` per-request token windows ([L] int32 each) into
+    one [mb, L+1] microbatch for `LMStream.submit`: row i holds request
+    i's window plus a zero trailing token (column L is the training
+    target slot — `_embed_tokens` drops it, so its value never reaches
+    the forward), and unused slots are all-zero. Slot VALIDITY lives
+    host-side (the submit tag), not in the array: every model op is
+    batch-row independent, so a garbage slot cannot perturb a valid one
+    bitwise (the per-slot isolation pin continuous batching rests on)."""
+    if len(windows) > mb:
+        raise ValueError(f"{len(windows)} windows > {mb} slots")
+    out = np.zeros((mb, max_len + 1), np.int32)
+    for i, w in enumerate(windows):
+        w = np.asarray(w, dtype=np.int32)
+        if w.shape != (max_len,):
+            raise ValueError(f"window {i} shape {w.shape} != ({max_len},)")
+        out[i, :max_len] = w
+    return out
 
 
 def make_synthetic_tokens(

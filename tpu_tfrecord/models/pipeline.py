@@ -598,7 +598,7 @@ class PipelineStream:
     def _inj(self, m: int) -> int:
         return (m // self._n_stages) * self._vs + m % self._n_stages
 
-    def _tick(self, x, ready: List[jax.Array]) -> None:
+    def _tick(self, x, ready: List[Tuple[jax.Array, Any]]) -> None:
         # the host owns the tick counter (self._t); the device step takes
         # it as a plain traced scalar each call
         head = self._pending[0][1] if self._pending else None
@@ -611,8 +611,8 @@ class PipelineStream:
             # Returned DEVICE-resident so downstream jits (e.g. the LM
             # head) consume it without a host round trip — callers that
             # want host bytes np.asarray it themselves
-            ready.append(out[self._n_stages - 1])
-            self._pending.popleft()
+            _, _, tag = self._pending.popleft()
+            ready.append((out[self._n_stages - 1], tag))
             self.served += 1
         self._t += 1
 
@@ -621,6 +621,14 @@ class PipelineStream:
         injection slot; returns the device-resident outputs (FIFO order)
         that completed along the way — usually one per push once the
         pipeline is full, none during warmup."""
+        return [out for out, _ in self.push_tagged(x)]
+
+    def push_tagged(self, x, tag: Any = None) -> List[Tuple[jax.Array, Any]]:
+        """`push` that rides an opaque host-side tag on the microbatch's
+        FIFO entry and returns ``(output, tag)`` pairs. The tag never
+        enters the compiled step (the per-call argument-bytes pin is
+        unchanged) — it exists so a multiplexer (the serving tier) can map
+        popped outputs back to the requests packed into each slot."""
         x = jnp.asarray(x)
         self._ensure_state(x.shape, x.dtype)
         # next injection slot the clock has not passed yet: a flush (or
@@ -632,9 +640,9 @@ class PipelineStream:
             m += 1
         inj = self._inj(m)
         # birth tick of m's last chunk on the last stage: inj + S·V - 1
-        self._pending.append((m, inj + self._vs - 1))
+        self._pending.append((m, inj + self._vs - 1, tag))
         self._m = m + 1
-        ready: List[jax.Array] = []
+        ready: List[Tuple[jax.Array, Any]] = []
         while self._t < inj:
             self._tick(self._zeros, ready)   # gap ticks between rounds
         self._tick(x, ready)                 # the injection tick itself
@@ -643,7 +651,11 @@ class PipelineStream:
     def flush(self) -> List[jax.Array]:
         """Drain: run permute/compute ticks (zero feed) until every pushed
         microbatch's output has popped; returns them in FIFO order."""
-        ready: List[jax.Array] = []
+        return [out for out, _ in self.flush_tagged()]
+
+    def flush_tagged(self) -> List[Tuple[jax.Array, Any]]:
+        """`flush` returning ``(output, tag)`` pairs (see `push_tagged`)."""
+        ready: List[Tuple[jax.Array, Any]] = []
         while self._pending:
             self._tick(self._zeros, ready)
         return ready

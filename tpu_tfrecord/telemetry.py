@@ -849,6 +849,37 @@ def training_verdict(shares: Optional[Dict[str, float]]) -> str:
     return "compute_bound"
 
 
+#: Queue depth (waiting requests) at or above this fraction of the
+#: serving tier's admission bound reads as queue pressure — requests are
+#: arriving faster than slots free, so the p99 miss is an ADMISSION
+#: problem (shed more / add a replica), not a model-speed problem.
+SERVE_QUEUE_BOUND_FILL = 0.5
+
+
+def serving_verdict(
+    p99_ms: Optional[float],
+    queue_depth: Optional[float],
+    slo_p99_ms: float,
+    max_queue: int = 16,
+) -> str:
+    """Latency-SLO verdict for the serving tier (the inference-side twin
+    of the bound-ness verdict): ``meeting_slo`` when per-request p99 is
+    within ``slo_p99_ms``; on a miss, ``queue_bound`` when the waiting
+    queue sits at ≥ ``SERVE_QUEUE_BOUND_FILL`` of the admission bound
+    (latency is queueing delay — shed harder or scale out) else
+    ``compute_bound`` (the compiled step itself is too slow for the SLO —
+    a smaller model/bigger mesh problem no replica count fixes).
+    ``unknown`` when no requests have completed yet."""
+    if p99_ms is None:
+        return "unknown"
+    if p99_ms <= slo_p99_ms:
+        return "meeting_slo"
+    depth = 0.0 if queue_depth is None else float(queue_depth)
+    if depth >= SERVE_QUEUE_BOUND_FILL * max(1, int(max_queue)):
+        return "queue_bound"
+    return "compute_bound"
+
+
 class OccupancyEma:
     """Shared smoothing for the bound-ness occupancy gauges: one EMA
     (alpha 0.2 — the verdict reflects the recent regime, not the epoch's

@@ -124,8 +124,14 @@ COUNTERS: Dict[str, str] = {
     # -- async checkpointing (snapshot/commit split)
     "ckpt.bytes_written": "checkpoint bytes committed to disk",
     "ckpt.generations_swept": "retired/dead checkpoint generations removed",
-    # -- streamed serving (pipeline inference mode)
-    "serve.requests": "microbatches served by the streaming pipeline",
+    # -- streamed serving (pipeline inference mode + the serving tier)
+    "serve.requests": "generation requests completed by the serving tier",
+    "serve.rejected": "requests shed at admission (queue full / draining)",
+    "serve.deadline_expired": "requests dropped by their deadline (admission or in flight)",
+    "serve.disconnects": "client connections lost mid-request (slots freed)",
+    "serve.ticks": "continuous-batching scheduler ticks (microbatches packed)",
+    "serve.errors": "serving engine ticks / completion callbacks that raised",
+    "elastic.replicas_lost": "serving replicas that died undrained (SIGKILL/crash)",
 }
 
 #: Throughput stages (``Metrics.add``/``timed``) and observe-only histogram
@@ -163,7 +169,7 @@ STAGES: Dict[str, str] = {
     "pipeline.bubble_fraction": "pipeline schedule idle-tick fraction",
     "pipeline.bubble_fraction_v": "interleaved (V>1) schedule bubble fraction",
     # streamed serving: a real latency histogram (not dimensionless)
-    "serve.latency": "one streamed microbatch, push -> logits pop",
+    "serve.latency": "one serving request, admission -> last token",
 }
 
 #: Instantaneous gauges (``Metrics.gauge``): last write wins.
@@ -174,6 +180,9 @@ GAUGES: Dict[str, str] = {
     "write.occupancy": "EMA of writer slab-queue fill (write verdict input)",
     "write.inflight_slabs": "slabs in flight in the write pipeline",
     "elastic.workers": "decode worker processes the scaler believes live",
+    "elastic.replicas": "serving replicas the serving scaler believes active",
+    "serve.queue_depth": "serving admission queue fill (requests waiting to start)",
+    "serve.in_flight": "requests riding the serving pipeline right now",
     "service.partition": "partition index this process serves (or routes to)",
     "train.share.data_wait": "windowed share of step wall in data wait",
     "train.share.h2d": "windowed share of step wall in h2d",
