@@ -1501,6 +1501,52 @@ def _model_parallel_child() -> None:
     if mem:
         out["lm_compiled_memory"] = mem
 
+    # --- fsdp weight sharding (full GSPMD mesh, PR 19): per-device
+    # at-rest bytes (params + opt state + inputs = compiled argument
+    # bytes) for the SAME LM step under dp×fsdp vs pure dp — the number
+    # the gather-on-use layout exists to shrink — plus the best-fit
+    # packer's density on a ragged corpus (what segment-masked packing
+    # buys over padding each document to L)
+    from tpu_tfrecord.tpu import TokenPacker
+
+    def _arg_bytes(mesh_axes, fsdp_axis):
+        m = create_mesh(mesh_axes)
+        p = lm.init_params(jax.random.key(0), cfg)
+        p = jax.device_put(
+            p, lm.param_shardings(m, p, fsdp_axis=fsdp_axis)
+        )
+        o = tx.init(p)
+        t = jax.device_put(toks, NamedSharding(m, P("data", None)))
+        s = jax.jit(
+            functools.partial(
+                lm.train_step, cfg=cfg, tx=tx, mesh=m,
+                data_axis="data", fsdp_axis=fsdp_axis,
+            )
+        )
+        ma_s = s.lower(p, o, t).compile().memory_analysis()
+        return (
+            int(ma_s.argument_size_in_bytes) if ma_s is not None else None
+        )
+
+    b_dp = _arg_bytes({"data": 8}, None)
+    b_fsdp = _arg_bytes({"data": 2, "fsdp": 4}, "fsdp")
+    if b_dp and b_fsdp:
+        out["lm_dp_param_bytes_per_device"] = b_dp
+        out["lm_fsdp_param_bytes_per_device"] = b_fsdp
+        out["lm_fsdp_param_shrink"] = round(b_dp / b_fsdp, 2)
+        out["lm_fsdp_shape"] = "dp2xfsdp4 vs dp8, same step"
+
+    prng = np.random.default_rng(15)
+    packer = TokenPacker(4, 32, packing="best_fit")
+    packer.feed_docs(
+        np.ones(int(s), np.int32)
+        for s in prng.choice([2, 6, 10, 15, 16, 21, 25, 31], size=300)
+    )
+    while packer.pop() is not None:
+        pass
+    out["pack_density"] = round(packer.density(), 4)
+    out["pack_shape"] = "B=4 L=32 best_fit ragged[2..31]x300"
+
     # --- training flight recorder (ISSUE 13): the REAL harness loop
     # (StepPhases + DeviceIterator) over device-fed synthetic batches —
     # the per-step phase decomposition + training verdict, measured, not
@@ -2040,6 +2086,12 @@ _PREV_NOISE_BANDS = {
     # a compiled CPU loop on a shared box
     "pipeline_input_shrink": 0.10,
     "lm_steps_per_s": 0.50,
+    # fsdp leg (PR 19): both deterministic — per-device at-rest bytes
+    # (smaller is better: a rise means weights stopped living sharded)
+    # and the best-fit packer density on the fixed ragged corpus (a drop
+    # means the binning regressed toward greedy/padding)
+    "lm_fsdp_param_bytes_per_device": 0.10,
+    "pack_density": 0.05,
     # streamed serving: a compiled CPU per-tick loop on a shared box (the
     # bubble sweep itself is deterministic and not banded — smaller is
     # better, the tests pin it against the analytic)
@@ -2078,6 +2130,7 @@ _PREV_NOISE_BANDS = {
 #: Fields where SMALLER is better: _vs_previous inverts the flag logic
 #: (delta above the band = regression, below = improvement).
 _SMALLER_IS_BETTER = {
+    "lm_fsdp_param_bytes_per_device",
     "ckpt_async_share",
     "ckpt_commit_p99_ms_pytree",
     "ckpt_commit_p99_ms_npz",

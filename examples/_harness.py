@@ -286,6 +286,39 @@ def fold_model_diagnostics(diag, metrics=None) -> Dict[str, float]:
     return out
 
 
+def report_mesh(mesh, metrics=None) -> Dict[str, int]:
+    """Publish the trainer's mesh shape as ``train.mesh.<axis>`` gauges
+    (axis name -> extent), so the spool ships the parallelism layout to
+    the fleet and `tfrecord_doctor train` can print WHICH mesh a trainer
+    is flying (a dp×fsdp×pp trainer and a pure-dp one look identical in
+    phase shares; they are very different machines). Returns the shape
+    dict (the caller may log it)."""
+    metrics = metrics or METRICS
+    shape = {
+        name: int(size)
+        for name, size in zip(mesh.axis_names, mesh.devices.shape)
+    }
+    for name, size in shape.items():
+        metrics.gauge(f"train.mesh.{name}", size)
+    return shape
+
+
+def report_fsdp_param_bytes(params, metrics=None) -> int:
+    """Per-device AT-REST param bytes of an fsdp-placed tree (sum of each
+    leaf's local shard), published as the ``lm.fsdp_param_bytes`` gauge —
+    the number the gather-on-use layout exists to shrink, shipped with
+    the spool so the fleet doctor sees it next to the mesh shape."""
+    import numpy as np
+
+    metrics = metrics or METRICS
+    per_dev = sum(
+        int(np.prod(p.sharding.shard_shape(p.shape))) * p.dtype.itemsize
+        for p in jax.tree.leaves(params)
+    )
+    metrics.gauge("lm.fsdp_param_bytes", per_dev)
+    return per_dev
+
+
 def trainer_spool(spool_dir: Optional[str] = None, interval_s=None):
     """Acquire this process's telemetry spool under the ``trainer`` role
     (None when no dir is configured). Falls back to the

@@ -10,7 +10,10 @@ The trainer that proves the model-parallel layer (ISSUE 10 / ROADMAP #4):
   4. jit train steps whose attention is ZIGZAG CAUSAL RING over the 'seq'
      axis (--mesh dp_sp, default), or whose blocks run as PIPELINE stages
      over the 'pipe' axis (--mesh dp_pp: the dp×pp composed mesh with the
-     scale-shaped O(mb) microbatch stream), or plain dp (--mesh dp)
+     scale-shaped O(mb) microbatch stream), or plain dp (--mesh dp), or
+     with GSPMD WEIGHT SHARDING over the 'fsdp' axis (--mesh dp_fsdp /
+     dp_fsdp_pp: params + optimizer state live sharded, gather on use —
+     per-device at-rest bytes shrink ~linearly in the fsdp extent)
   5. checkpoint params + optimizer + IteratorState + packer carry in ONE
      atomic file every --save-every steps; kill -9 and rerun to resume —
      the packed-batch stream and the loss curve continue byte-identically
@@ -99,11 +102,27 @@ def pick_mesh(kind: str, virtual: int = 1):
     """(mesh, cfg axes, n_layers) for the requested parallelism on however
     many devices exist (odd counts degrade to dp). ``virtual`` > 1 picks
     the interleaved dp_pp shape: 2 stages × V round-robin chunks of the
-    same 4 layers, cutting the bubble toward (S-1)/(V·M+S-1)."""
+    same 4 layers, cutting the bubble toward (S-1)/(V·M+S-1). The fsdp
+    kinds add GSPMD weight sharding: params live sharded over the 'fsdp'
+    axis and gather on use (models.lm), so per-device at-rest bytes for
+    params + optimizer state shrink ~linearly in the fsdp extent."""
     n_dev = len(jax.devices())
     if kind == "dp_sp" and n_dev % 2 == 0:
         mesh = create_mesh({"data": n_dev // 2, "seq": 2})
         return mesh, {"data_axis": "data", "seq_axis": "seq"}, 2
+    if kind == "dp_fsdp" and n_dev % 2 == 0:
+        mesh = create_mesh({"data": 2, "fsdp": n_dev // 2})
+        return mesh, {"data_axis": "data", "fsdp_axis": "fsdp"}, 2
+    if kind == "dp_fsdp_pp" and n_dev % 8 == 0:
+        mesh = create_mesh({"pipe": 2, "data": 2, "fsdp": n_dev // 4})
+        return mesh, {
+            "data_axis": "data", "pipe_axis": "pipe", "fsdp_axis": "fsdp",
+        }, 4
+    if kind == "dp_fsdp_pp" and n_dev % 4 == 0:
+        mesh = create_mesh({"pipe": 2, "data": 1, "fsdp": n_dev // 2})
+        return mesh, {
+            "data_axis": "data", "pipe_axis": "pipe", "fsdp_axis": "fsdp",
+        }, 4
     if kind == "dp_pp" and virtual > 1 and n_dev % 2 == 0:
         mesh = create_mesh({"pipe": 2, "data": n_dev // 2})
         return mesh, {"data_axis": "data", "pipe_axis": "pipe"}, 4
@@ -189,7 +208,8 @@ def packed_stream(it, packer: TokenPacker, snaps: dict):
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--mesh", default=os.environ.get("LM_MESH", "dp_sp"),
-                    choices=("dp", "dp_sp", "dp_pp"))
+                    choices=("dp", "dp_sp", "dp_pp", "dp_fsdp",
+                             "dp_fsdp_pp"))
     ap.add_argument("--steps", type=int, default=64,
                     help="total train steps (absolute, incl. resumed)")
     ap.add_argument("--save-every", type=int, default=8)
@@ -240,8 +260,7 @@ def main() -> None:
         moe_experts=args.moe,
         n_virtual=args.virtual if "pipe_axis" in axes else 1,
     )
-    print(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))} "
-          f"mode={args.mesh}")
+    print(f"mesh: {_harness.report_mesh(mesh)} mode={args.mesh}")
 
     params = lm.init_params(jax.random.key(0), cfg)
     tx = optax.adam(3e-3)
@@ -249,11 +268,20 @@ def main() -> None:
     os.makedirs(args.ckpt_dir, exist_ok=True)
     ck = LMCheckpoint(args.ckpt_dir, sync=(args.ckpt_mode == "sync"))
     start_step, (params, opt_state), payload = ck.load((params, opt_state))
-    if "pipe_axis" in axes:
+    placement = {
+        k: axes[k] for k in ("pipe_axis", "fsdp_axis") if k in axes
+    }
+    if placement:
+        # at-rest sharding: P(pipe) stage slicing and/or P(fsdp) weight
+        # sharding; the restored host tree places under ANY layout — the
+        # checkpoint itself is layout-free (tests/test_lm_fsdp.py pins
+        # the interchange)
         params = jax.device_put(
-            params,
-            lm.param_shardings(mesh, params, pipe_axis=axes["pipe_axis"]),
+            params, lm.param_shardings(mesh, params, **placement)
         )
+        if "fsdp_axis" in placement:
+            per_dev = _harness.report_fsdp_param_bytes(params)
+            print(f"fsdp param bytes/device: {per_dev}")
     if start_step is None:
         start_step = 0
         print("fresh start")

@@ -310,6 +310,76 @@ def _build_lm_train_step():
     return step, (p_sh, opt, toks)
 
 
+def _build_lm_train_step_fsdp():
+    import functools
+
+    import jax
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_tfrecord.models import lm
+    from tpu_tfrecord.tpu import create_mesh
+
+    cfg = lm.LMConfig(
+        vocab_size=64, d_model=16, n_heads=2, n_layers=4, max_len=16,
+    )
+    mesh = create_mesh({"data": 2, "fsdp": 4})
+    params = lm.init_params(jax.random.key(0), cfg)
+    p_sh = jax.device_put(
+        params, lm.param_shardings(mesh, params, fsdp_axis="fsdp")
+    )
+    tx = optax.sgd(1e-2)
+    opt = tx.init(p_sh)  # zeros_like inherits the sharded placement
+    toks = jax.device_put(
+        jax.numpy.asarray(lm.make_synthetic_tokens(cfg, 8, seed=0)),
+        NamedSharding(mesh, P("data", None)),
+    )
+    step = jax.jit(
+        functools.partial(
+            lm.train_step, cfg=cfg, tx=tx, mesh=mesh, data_axis="data",
+            fsdp_axis="fsdp",
+        )
+    )
+    return step, (p_sh, opt, toks)
+
+
+def _build_lm_train_step_fsdp_pp():
+    import functools
+
+    import jax
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_tfrecord.models import lm
+    from tpu_tfrecord.tpu import create_mesh
+
+    cfg = lm.LMConfig(
+        vocab_size=64, d_model=16, n_heads=2, n_layers=4, max_len=16,
+        n_micro=4,
+    )
+    mesh = create_mesh({"pipe": 2, "data": 2, "fsdp": 2})
+    params = lm.init_params(jax.random.key(0), cfg)
+    p_sh = jax.device_put(
+        params,
+        lm.param_shardings(
+            mesh, params, pipe_axis="pipe", fsdp_axis="fsdp"
+        ),
+    )
+    tx = optax.sgd(1e-2)
+    opt = tx.init(p_sh)
+    toks = jax.device_put(
+        jax.numpy.asarray(lm.make_synthetic_tokens(cfg, 8, seed=0)),
+        NamedSharding(mesh, P("data", None)),
+    )
+    step = jax.jit(
+        functools.partial(
+            lm.train_step, cfg=cfg, tx=tx, mesh=mesh, data_axis="data",
+            pipe_axis="pipe", fsdp_axis="fsdp",
+        )
+    )
+    return step, (p_sh, opt, toks)
+
+
 #: The manifest. Every historical inline pin appears here exactly once;
 #: the diagnostics rows pin that the flag adds no forbidden collective
 #: (its off twin is the same entrypoint's plain row).
@@ -391,6 +461,30 @@ CONTRACTS: Dict[str, HloContract] = {
             builder=_build_lm_train_step,
             note="the acceptance pin at the train-step level; grads over "
             "'data' still all-reduce — dp's collective, not the pipeline's",
+        ),
+        HloContract(
+            name="lm_train_step_fsdp",
+            entrypoint="models.lm.train_step (dp x fsdp)",
+            contains=("all-gather",),
+            absent=("all-to-all", "collective-permute"),
+            builder=_build_lm_train_step_fsdp,
+            note="weight sharding gathers ON USE — the all-gathers are the "
+            "forward's per-weight materializations; grads reduce on the "
+            "SHARDED layout into sharded opt state (tests pin per-device "
+            "param+opt bytes shrinking ~linearly in the fsdp axis, so no "
+            "full gather of grads can hide here)",
+        ),
+        HloContract(
+            name="lm_train_step_fsdp_pp",
+            entrypoint="models.lm.train_step (dp x fsdp x pp)",
+            contains=("collective-permute", "all-gather"),
+            absent=("all-to-all",),
+            builder=_build_lm_train_step_fsdp_pp,
+            note="the full composed mesh: the pipeline's stream still moves "
+            "ONLY by neighbor permute, while the stage weights — at rest "
+            "P(pipe, fsdp, ...) — all-gather their fsdp dim once per step "
+            "at the pipeline_apply param_spec boundary (gather-on-use "
+            "composed under stage slicing)",
         ),
     )
 }

@@ -1030,6 +1030,14 @@ def _train_report(args, emit) -> int:
         bubble = p.gauges.get("pipeline.bubble_fraction")
         if bubble is not None:
             line["pipeline_bubble_fraction"] = round(bubble, 4)
+        # the mesh shape gauges (examples/_harness.report_mesh): which
+        # parallelism layout this trainer is flying
+        mesh_shape = {
+            k[len("train.mesh."):]: int(v)
+            for k, v in p.gauges.items() if k.startswith("train.mesh.")
+        }
+        if mesh_shape:
+            line["mesh"] = dict(sorted(mesh_shape.items()))
         if p.skipped_lines:
             line["skipped_lines"] = p.skipped_lines
         emit(line)

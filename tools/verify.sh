@@ -669,6 +669,57 @@ print("lm smoke OK:", json.dumps({
 }))
 PY
 
+echo "== LM fsdp smoke (dp x fsdp weight sharding: same data, same loss as pure dp + HLO contract rows) =="
+# The full-GSPMD-mesh leg (PR 19): train 8 steps under --mesh dp and
+# --mesh dp_fsdp over the SAME generated dataset. Weight sharding is a
+# layout choice, not a numerics choice: the packed-batch digests must be
+# byte-identical and the per-step losses equal to float tolerance, the
+# trainer must report its sharded per-device param bytes, and the two
+# fsdp HLO contract rows (gather-on-use dp×fsdp, and dp×fsdp×pp composed
+# under the pipeline's boundary reshard) must pass against live compiles.
+env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python - <<'PY' || exit 1
+import json, os, re, subprocess, sys, tempfile
+
+root = tempfile.mkdtemp(prefix="tfr_lm_fsdp_smoke_")
+data = os.path.join(root, "data")
+
+def run(mesh, tag):
+    digests = os.path.join(root, tag + ".jsonl")
+    res = subprocess.run(
+        [sys.executable, "examples/train_lm.py", "--mesh", mesh,
+         "--steps", "8", "--save-every", "4", "--data-dir", data,
+         "--ckpt-dir", os.path.join(root, "ck_" + tag),
+         "--digest-out", digests],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert res.returncode == 0, (res.returncode, res.stdout[-2000:],
+                                 res.stderr[-1000:])
+    lines = {json.loads(l)["step"]: json.loads(l) for l in open(digests)}
+    return res.stdout, lines
+
+_, dp = run("dp", "dp")
+out_f, fsdp = run("dp_fsdp", "fsdp")
+m = re.search(r"fsdp param bytes/device: (\d+)", out_f)
+assert m, out_f[-1500:]
+per_dev = int(m.group(1))
+assert "'fsdp': 4" in out_f, out_f[-1500:]
+assert sorted(dp) == sorted(fsdp) == list(range(1, 9)), (sorted(dp), sorted(fsdp))
+for s in dp:
+    assert dp[s]["digest"] == fsdp[s]["digest"], (s, dp[s], fsdp[s])
+    d = abs(float(dp[s]["loss"]) - float(fsdp[s]["loss"]))
+    assert d < 5e-4, (s, dp[s], fsdp[s])
+
+from tools.graftlint import hlo_contracts
+for row in ("lm_train_step_fsdp", "lm_train_step_fsdp_pp"):
+    hlo_contracts.verify(row)
+print("lm fsdp smoke OK:", json.dumps({
+    "steps_compared": len(dp),
+    "fsdp_param_bytes_per_device": per_dev,
+    "contract_rows": ["lm_train_step_fsdp", "lm_train_step_fsdp_pp"],
+}))
+PY
+
 echo "== serving smoke (train_lm dp_pp interleaved -> serve_lm streams the checkpoint byte-identically) =="
 # The inference path end-to-end (ISSUE 15): train the LM on the dp×pp
 # interleaved mesh (2 stages × 2 virtual chunks), leave its atomic

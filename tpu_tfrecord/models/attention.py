@@ -42,7 +42,6 @@ way; callers keep the contiguous contract on both sides.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
@@ -68,18 +67,26 @@ def _expand_kv(q, kv):
 
 
 def attention_reference(
-    q, k, v, lengths=None, scale: Optional[float] = None, causal: bool = False
+    q, k, v, lengths=None, scale: Optional[float] = None, causal: bool = False,
+    segments=None,
 ):
     """Dense softmax attention oracle. q [B, L, H, D], k/v [B, L, Hkv, D]
     with Hkv == H (MHA) or H % Hkv == 0 (GQA/MQA: each K/V head serves
     H/Hkv query heads) -> [B, L, H, D]. ``causal`` masks keys after each
-    query position (decoder/LM attention)."""
+    query position (decoder/LM attention). ``segments`` [B, L] int makes
+    the mask block-diagonal within the causal triangle: position i attends
+    to j only when segments[b, i] == segments[b, j], so documents packed
+    into one row (TokenPacker's bin modes) never leak mass across their
+    boundaries."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     k, v = _expand_kv(q, k), _expand_kv(q, v)
     scores = jnp.einsum("blhd,bmhd->bhlm", q, k).astype(jnp.float32) * scale
     if lengths is not None:
         valid = jnp.arange(k.shape[1])[None, :] < lengths[:, None]  # [B, M]
         scores = jnp.where(valid[:, None, None, :], scores, _NEG)
+    if segments is not None:
+        same = segments[:, :, None] == segments[:, None, :]       # [B, L, M]
+        scores = jnp.where(same[:, None, :, :], scores, _NEG)
     if causal:
         l, m = q.shape[1], k.shape[1]
         tri = jnp.arange(m)[None, :] <= jnp.arange(l)[:, None]    # [L, M]
@@ -90,7 +97,7 @@ def attention_reference(
 
 def _ring_attention_local(
     q, k, v, lengths, scale: float, axis_name: str, causal: bool = False,
-    zigzag: bool = False,
+    zigzag: bool = False, segments=None,
 ):
     """Per-device body (inside shard_map): q,k,v are the local sequence
     chunks [B, Lc, H, D]; K/V rotate one neighbor per step.
@@ -104,7 +111,14 @@ def _ring_attention_local(
     lax.cond'd half-block einsums (the diagonal step keeps the full
     masked block). Work is balanced per step AND per device, at half the
     dense FLOPs; the output swaps back before return, so callers keep the
-    contiguous [B, L, ...] contract end to end."""
+    contiguous [B, L, ...] contract end to end.
+
+    ``segments`` [B, Lc] (the local chunk of a [B, L] per-position segment
+    id array) adds the packed-document block-diagonal mask: a segment
+    block rides every K/V rotation (and the zigzag restripe), and EVERY
+    fold path applies it — the zigzag half blocks are causally unmasked
+    by construction but still cross document boundaries, so the segment
+    mask is orthogonal to the causal one there."""
     p = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     if zigzag:
@@ -116,6 +130,8 @@ def _ring_attention_local(
             return jnp.concatenate([x[:, :half], other], axis=1)
 
         q, k, v = restripe(q), restripe(k), restripe(v)
+        if segments is not None:
+            segments = restripe(segments)
     b, lc, h, d = q.shape
     positions = jnp.arange(lc)
 
@@ -143,7 +159,7 @@ def _ring_attention_local(
         o = o * corr.transpose(0, 2, 1)[..., None] + upd
         return new_m, l, o
 
-    def accumulate(step_i, k_blk, v_blk, m, l, o):
+    def accumulate(step_i, k_blk, v_blk, seg_blk, m, l, o):
         # GQA: the rotating blocks carry only Hkv heads (comm-optimal);
         # repeat to H locally — XLA fuses the broadcast into the einsum
         scores = (
@@ -159,6 +175,9 @@ def _ring_attention_local(
         if lengths is not None:
             valid = key_pos[None, :] < lengths[:, None]       # [B, Lk]
             scores = jnp.where(valid[:, None, None, :], scores, _NEG)
+        if segments is not None:
+            same = segments[:, :, None] == seg_blk[:, None, :]  # [B, Lq, Lk]
+            scores = jnp.where(same[:, None, :, :], scores, _NEG)
         if causal:
             # mask by GLOBAL positions; a fully-future block masks to _NEG
             # everywhere and contributes ~0 mass (the m0=-1e30 floor keeps
@@ -168,12 +187,13 @@ def _ring_attention_local(
             scores = jnp.where(tri[None, None, :, :], scores, _NEG)
         return online_update(scores, _expand_kv(q, v_blk), m, l, o)
 
-    def accumulate_zigzag(step_i, k_blk, v_blk, m, l, o):
+    def accumulate_zigzag(step_i, k_blk, v_blk, seg_blk, m, l, o):
         """Balanced causal step for NON-diagonal blocks (step_i >= 1; step
         0 is the device's own block — the causal diagonal — folded once
         through ``accumulate`` before the loop): exactly HALF the score
-        matrix is needed and that half is strictly unmasked by strip
-        construction, so only it is computed."""
+        matrix is needed and that half is strictly unmasked (CAUSALLY) by
+        strip construction, so only it is computed; the segment mask still
+        applies to it — packed-document boundaries do not follow strips."""
         s = lc // 2
         src = jax.lax.rem(idx - step_i + p, p)
         key_pos = dev_pos(src)
@@ -183,6 +203,14 @@ def _ring_attention_local(
                 return scores
             valid = kp[None, :] < lengths[:, None]
             return jnp.where(valid[:, None, None, :], scores, _NEG)
+
+        def seg_mask(scores, sq, sk):
+            # sq [B, R] query-side ids, sk [B, K] key-side ids for exactly
+            # the rows/keys this half fold touches
+            if segments is None:
+                return scores
+            same = sq[:, :, None] == sk[:, None, :]
+            return jnp.where(same[:, None, :, :], scores, _NEG)
 
         # both half-starts share the same selector: the EARLY half when the
         # block comes from a lower rank, the LATE half otherwise
@@ -199,7 +227,11 @@ def _ring_attention_local(
                 )
                 * scale
             )
-            return online_update(len_mask(scores, kp), _expand_kv(q, vh), m, l, o)
+            scores = len_mask(scores, kp)
+            if segments is not None:
+                skh = jax.lax.dynamic_slice_in_dim(seg_blk, start, s, axis=1)
+                scores = seg_mask(scores, segments, skh)
+            return online_update(scores, _expand_kv(q, vh), m, l, o)
 
         def half_q(m, l, o):
             # all keys against ONE q-half: fold into that half's slice of
@@ -212,6 +244,9 @@ def _ring_attention_local(
                 * scale
             )
             scores = len_mask(scores, key_pos)
+            if segments is not None:
+                sqh = jax.lax.dynamic_slice_in_dim(segments, start, s, axis=1)
+                scores = seg_mask(scores, sqh, seg_blk)
             ms = jax.lax.dynamic_slice_in_dim(m, start, s, axis=2)
             ls = jax.lax.dynamic_slice_in_dim(l, start, s, axis=2)
             os_ = jax.lax.dynamic_slice_in_dim(o, start, s, axis=1)
@@ -241,25 +276,44 @@ def _ring_attention_local(
     # Step 0 is always the device's OWN block — the causal diagonal — so
     # the full masked fold happens exactly once, hoisted out of the loop;
     # the loop body then carries only the half-block program under zigzag.
-    m, l, o = accumulate(0, k, v, m0, l0, o0)
+    m, l, o = accumulate(0, k, v, segments, m0, l0, o0)
     if p > 1:
         rest = accumulate_zigzag if (zigzag and causal) else accumulate
         # rotate K/V one neighbor around the ring (ICI hop); p-1 hops in
-        # total — the final block needs no outgoing hop
+        # total — the final block needs no outgoing hop. Segment ids ride
+        # the same hops so every arriving block knows its document ids.
         k_blk = jax.lax.ppermute(k, axis_name, perm)
         v_blk = jax.lax.ppermute(v, axis_name, perm)
+        s_blk = (
+            jax.lax.ppermute(segments, axis_name, perm)
+            if segments is not None else None
+        )
 
         def step(i, carry):
-            k_blk, v_blk, m, l, o = carry
-            m, l, o = rest(i, k_blk, v_blk, m, l, o)
+            if segments is None:
+                k_blk, v_blk, m, l, o = carry
+                s_cur = None
+            else:
+                k_blk, v_blk, s_cur, m, l, o = carry
+            m, l, o = rest(i, k_blk, v_blk, s_cur, m, l, o)
             k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
             v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-            return k_blk, v_blk, m, l, o
+            if segments is None:
+                return k_blk, v_blk, m, l, o
+            s_cur = jax.lax.ppermute(s_cur, axis_name, perm)
+            return k_blk, v_blk, s_cur, m, l, o
 
-        k_blk, v_blk, m, l, o = jax.lax.fori_loop(
-            1, p - 1, step, (k_blk, v_blk, m, l, o)
+        carry0 = (
+            (k_blk, v_blk, m, l, o) if segments is None
+            else (k_blk, v_blk, s_blk, m, l, o)
         )
-        m, l, o = rest(p - 1, k_blk, v_blk, m, l, o)
+        out_carry = jax.lax.fori_loop(1, p - 1, step, carry0)
+        if segments is None:
+            k_blk, v_blk, m, l, o = out_carry
+            s_blk = None
+        else:
+            k_blk, v_blk, s_blk, m, l, o = out_carry
+        m, l, o = rest(p - 1, k_blk, v_blk, s_blk, m, l, o)
     out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     if zigzag:
         out = restripe(out)  # the half-swap is an involution: swap back
@@ -268,35 +322,42 @@ def _ring_attention_local(
 
 def _shard_map_attention(
     local_fn, q, k, v, mesh, seq_axis, data_axis, lengths, scale,
-    causal=False, **local_kwargs,
+    causal=False, segments=None, **local_kwargs,
 ):
     """Shared dispatch for both SP flavors: one shard_map over the sequence
     axis (batch optionally on ``data_axis`` — an unsharded spec on a sharded
     batch would silently gather it to every device), ``lengths`` riding
-    along per-batch when given."""
+    along per-batch and ``segments`` [B, L] per-position (sharded like the
+    sequence itself) when given."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     spec = P(data_axis, seq_axis, None, None)
-    if lengths is None:
-        fn = shard_map(
-            functools.partial(
-                local_fn, lengths=None, scale=scale, axis_name=seq_axis,
-                causal=causal, **local_kwargs,
-            ),
-            mesh=mesh,
-            in_specs=(spec, spec, spec),
-            out_specs=spec,
+    in_specs = [spec, spec, spec]
+    args = [q, k, v]
+    if lengths is not None:
+        in_specs.append(P(data_axis))
+        args.append(lengths)
+    if segments is not None:
+        in_specs.append(P(data_axis, seq_axis))
+        args.append(segments)
+
+    def body(*arrs):
+        qb, kb, vb = arrs[:3]
+        j = 3
+        lb = sb = None
+        if lengths is not None:
+            lb = arrs[j]
+            j += 1
+        if segments is not None:
+            sb = arrs[j]
+        return local_fn(
+            qb, kb, vb, lengths=lb, scale=scale, axis_name=seq_axis,
+            causal=causal, segments=sb, **local_kwargs,
         )
-        return fn(q, k, v)
+
     fn = shard_map(
-        functools.partial(
-            local_fn, scale=scale, axis_name=seq_axis, causal=causal,
-            **local_kwargs,
-        ),
-        mesh=mesh,
-        in_specs=(spec, spec, spec, P(data_axis)),
-        out_specs=spec,
+        body, mesh=mesh, in_specs=tuple(in_specs), out_specs=spec
     )
-    return fn(q, k, v, lengths)
+    return fn(*args)
 
 
 def ring_attention(
@@ -310,6 +371,7 @@ def ring_attention(
     scale: Optional[float] = None,
     causal: bool = False,
     zigzag: bool = False,
+    segments: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Exact attention over a sequence sharded on ``mesh[seq_axis]``.
 
@@ -318,6 +380,9 @@ def ring_attention(
     the group repeat fuses locally). L divisible by the axis size. Pass
     ``data_axis`` to keep the batch dim sharded. ``lengths`` [B] masks
     padded key positions (the ingest layer's ``<name>_len`` output).
+    ``segments`` [B, L] int ids make the mask block-diagonal across
+    packed documents (see `attention_reference`); the ids shard on the
+    sequence axis and ride the K/V ring rotations.
 
     ``zigzag`` (causal only): the balanced causal-ring schedule. One
     ppermute involution inside the kernel swaps second chunk-halves
@@ -342,14 +407,20 @@ def ring_attention(
                 f"== 0 (got L={q.shape[1]}, axis size "
                 f"{mesh.shape[seq_axis]})"
             )
+    if segments is not None and segments.shape != q.shape[:2]:
+        raise ValueError(
+            f"segments shape {segments.shape} != batch/sequence dims "
+            f"{q.shape[:2]} of q"
+        )
     return _shard_map_attention(
         _ring_attention_local, q, k, v, mesh, seq_axis, data_axis, lengths,
-        scale, causal, zigzag=zigzag,
+        scale, causal, segments=segments, zigzag=zigzag,
     )
 
 
 def _ulysses_attention_local(
-    q, k, v, lengths, scale: float, axis_name: str, causal: bool = False
+    q, k, v, lengths, scale: float, axis_name: str, causal: bool = False,
+    segments=None,
 ):
     """Per-device body (inside shard_map): q,k,v are the local sequence
     chunks [B, Lc, H, D]. Two all-to-alls re-shard sequence<->heads; the
@@ -361,9 +432,19 @@ def _ulysses_attention_local(
         jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
         for x in (q, k, v)
     )
+    if segments is not None:
+        # post-exchange attention spans the full sequence, so every device
+        # needs every segment id — an all_gather of [B, Lc] ints, trivial
+        # next to the activation all-to-alls
+        segments = jax.lax.all_gather(
+            segments, axis_name, axis=1, tiled=True
+        )
     # post-exchange each device holds the FULL sequence for its head
     # group, so the dense oracle's local causal mask IS the global one
-    out = attention_reference(qh, kh, vh, lengths=lengths, scale=scale, causal=causal)
+    out = attention_reference(
+        qh, kh, vh, lengths=lengths, scale=scale, causal=causal,
+        segments=segments,
+    )
     # inverse exchange: [B, L, H/p, D] -> [B, Lc, H, D]
     return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
@@ -378,11 +459,13 @@ def ulysses_attention(
     lengths: Optional[jax.Array] = None,
     scale: Optional[float] = None,
     causal: bool = False,
+    segments: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Exact attention over a sequence sharded on ``mesh[seq_axis]`` via the
     all-to-all (DeepSpeed-Ulysses) pattern — same contract and results as
-    :func:`ring_attention`, different collective/memory profile (see module
-    docstring for when to pick which).
+    :func:`ring_attention` (including ``segments`` packed-document
+    masking), different collective/memory profile (see module docstring
+    for when to pick which).
 
     q: [B, L, H, D]; k,v: [B, L, Hkv, D] (GQA: Hkv a positive divisor of
     H). L, H, AND Hkv must all be divisible by the axis size — each device
@@ -401,5 +484,5 @@ def ulysses_attention(
     # H % Hkv is guarded once, in _expand_kv (shared with the ring flavor)
     return _shard_map_attention(
         _ulysses_attention_local, q, k, v, mesh, seq_axis, data_axis, lengths,
-        scale, causal,
+        scale, causal, segments=segments,
     )

@@ -23,6 +23,22 @@ mesh axes the caller passes:
                                   interleaves V round-robin chunks per
                                   device (models.pipeline), cutting the
                                   bubble toward (S-1)/(V·M+S-1)
+- ``fsdp_axis``                -> GSPMD weight sharding (FSDP): every 2D+
+                                  parameter shards one dimension over the
+                                  axis at rest (`SpecLayout` is the spec
+                                  table), an all-gather materializes each
+                                  weight ON USE inside `_block`/`forward`,
+                                  and `train_step` constrains grads back
+                                  to the sharded layout so gradients and
+                                  optimizer state NEVER gather — per-
+                                  device param+opt bytes shrink ~linearly
+                                  in the axis (pinned). Composes with dp,
+                                  pp (the pipeline's param_spec boundary
+                                  does the per-step gather of each stage's
+                                  own weights), and EP (expert weights
+                                  shard expert×fsdp; the MoE shard_map
+                                  gathers only the fsdp dim — activations
+                                  are never re-sharded through the host)
 - `LMStream`                   -> the SERVING flavor: the same pipelined
                                   chunks behind a per-microbatch streamed
                                   step (push one [mb, L+1] request, pop
@@ -83,6 +99,80 @@ class LMConfig:
     # (d, d+S, ...), shrinking the bubble toward (S-1)/(V·M+S-1);
     # n_layers must divide by S·V
     n_virtual: int = 1
+
+
+@dataclass(frozen=True)
+class SpecLayout:
+    """The LM's mesh-axis spec table: one place that says which axis each
+    parameter dimension shards over (the SNIPPETS [3] `SpecLayout` idiom).
+    Any axis may be None — the spec degrades to replication on that
+    dimension — so ONE table serves every mesh composition: pure dp (all
+    None), dp×fsdp, dp×pp, dp×fsdp×pp, and dp×fsdp×EP.
+
+    Conventions: the stacked block dim ([n_layers, ...]) belongs to
+    ``pipe_axis`` (stage slicing); the first WEIGHT dim after it (fan-in
+    for dense kernels, d_model for the router, rows for embed/pos/head)
+    belongs to ``fsdp_axis``; the expert dim of MoE kernels belongs to
+    ``expert_axis``. 1-D-per-layer biases replicate over fsdp — sharding
+    them buys nothing and costs a gather each.
+    """
+
+    fsdp_axis: Optional[str] = None
+    pipe_axis: Optional[str] = None
+    expert_axis: Optional[str] = None
+
+    def embed(self) -> P:                       # [vocab, d_model]
+        return P(self.fsdp_axis, None)
+
+    def pos(self) -> P:                         # [max_len, d_model]
+        return P(self.fsdp_axis, None)
+
+    def head(self) -> Dict[str, P]:             # w [d_model, vocab]
+        return {"w": P(self.fsdp_axis, None), "b": P()}
+
+    def block_dense(self) -> Dict[str, P]:      # w [n_layers, fan_in, fan_out]
+        return {
+            "w": P(self.pipe_axis, self.fsdp_axis, None),
+            "b": P(self.pipe_axis, None),
+        }
+
+    def moe(self) -> Dict[str, P]:              # w_in [n_layers, E, d_model, d_ff]
+        return {
+            "router": P(self.pipe_axis, self.fsdp_axis, None),
+            "w_in": P(self.pipe_axis, self.expert_axis, self.fsdp_axis, None),
+            "w_out": P(self.pipe_axis, self.expert_axis, self.fsdp_axis, None),
+        }
+
+
+def param_specs(params, layout: SpecLayout) -> Dict[str, Any]:
+    """PartitionSpec pytree matching ``params``' structure, leaf-for-leaf,
+    from the spec table. Used by `param_shardings` for placement and by
+    `train_step` to constrain grads back to the sharded layout."""
+    blocks: Dict[str, Any] = {}
+    for name in params["blocks"]:
+        blocks[name] = layout.moe() if name == "moe" else layout.block_dense()
+    return {
+        "embed": layout.embed(),
+        "pos": layout.pos(),
+        "head": layout.head(),
+        "blocks": blocks,
+    }
+
+
+def _unshard_fn(mesh, fsdp_axis):
+    """The FSDP gather-on-use: a pytree-wide ``with_sharding_constraint``
+    to full replication, forcing XLA to all-gather the weight right where
+    it is consumed (and, in the transpose, to keep the weight's cotangent
+    from staying replicated — the grad constraint in `train_step` turns
+    that into a reduce+slice, never a gather of grads). Identity when no
+    fsdp axis is in play, so every other mode compiles the exact
+    pre-fsdp program."""
+    if mesh is None or fsdp_axis is None:
+        return lambda t: t
+    repl = NamedSharding(mesh, P())
+    return lambda t: jax.tree.map(
+        lambda a: jax.lax.with_sharding_constraint(a, repl), t
+    )
 
 
 def _dense_init(rng, fan_in: int, fan_out: int):
@@ -151,25 +241,31 @@ def _moe_cfg(cfg: LMConfig) -> "_moe.MoEConfig":
 
 def _block(
     layer, x, cfg: LMConfig, mesh=None, seq_axis=None, data_axis=None,
-    expert_axis=None, diagnostics=False,
+    expert_axis=None, fsdp_axis=None, segments=None, diagnostics=False,
 ):
     """One pre-norm decoder block on x [B, L, D]. Attention flavor: zigzag
-    causal ring over ``seq_axis`` when given, else dense causal. Returns
-    (x, aux, moe_diag) — moe_diag is None unless ``diagnostics`` is set
-    on an MoE block (models.moe _diag_dict vocabulary)."""
+    causal ring over ``seq_axis`` when given, else dense causal;
+    ``segments`` [B, L] masks attention across packed-document boundaries
+    in either flavor. With ``fsdp_axis``, every weight is gathered ON USE
+    (`_unshard_fn`) — EXCEPT the EP path's expert weights, whose reshard
+    belongs to the MoE shard_map boundary (it gathers the fsdp dim while
+    KEEPING the expert dim sharded; a full gather here would undo EP).
+    Returns (x, aux, moe_diag) — moe_diag is None unless ``diagnostics``
+    is set on an MoE block (models.moe _diag_dict vocabulary)."""
     dt = cfg.dtype
+    g = _unshard_fn(mesh, fsdp_axis)
     b, l, _ = x.shape
     h, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
-    qkv = _dense(layer["qkv"], _rms_norm(x), dt)
+    qkv = _dense(g(layer["qkv"]), _rms_norm(x), dt)
     q, k, v = (a.reshape(b, l, h, dh) for a in jnp.split(qkv, 3, axis=-1))
     if mesh is not None and seq_axis is not None:
         att = ring_attention(
             q, k, v, mesh, seq_axis=seq_axis, data_axis=data_axis,
-            causal=True, zigzag=cfg.zigzag,
+            causal=True, zigzag=cfg.zigzag, segments=segments,
         )
     else:
-        att = attention_reference(q, k, v, causal=True)
-    x = x + _dense(layer["proj"], att.reshape(b, l, cfg.d_model), dt)
+        att = attention_reference(q, k, v, causal=True, segments=segments)
+    x = x + _dense(g(layer["proj"]), att.reshape(b, l, cfg.d_model), dt)
     if cfg.moe_experts > 0:
         if mesh is not None and expert_axis is not None:
             out = _moe.moe_apply_ep(
@@ -179,23 +275,30 @@ def _block(
             )
         else:
             out = _moe.moe_apply(
-                layer["moe"], _rms_norm(x), _moe_cfg(cfg),
+                g(layer["moe"]), _rms_norm(x), _moe_cfg(cfg),
                 diagnostics=diagnostics,
             )
         y, aux = out[0], out[1]
         return x + y, aux, (out[2] if diagnostics else None)
-    y = _dense(layer["mlp_in"], _rms_norm(x), dt)
+    y = _dense(g(layer["mlp_in"]), _rms_norm(x), dt)
     return (
-        x + _dense(layer["mlp_out"], jax.nn.gelu(y), dt),
+        x + _dense(g(layer["mlp_out"]), jax.nn.gelu(y), dt),
         jnp.float32(0.0),
         None,
     )
 
 
-def _embed_tokens(params, tokens, cfg: LMConfig):
+def _embed_tokens(params, tokens, cfg: LMConfig, segments=None):
     """tokens [B, L+1] int32 -> x [B, L, D]: the model reads
     tokens[:, :-1]. Shared by the batch forward and the streamed server
-    (LMStream) — one embedding program, no drift between paths."""
+    (LMStream) — one embedding program, no drift between paths.
+
+    ``segments`` [B, L+1] (TokenPacker bin modes) switches the position
+    embedding to PER-DOCUMENT positions derived in-jit from the ids: each
+    segment restarts at position 0, so a document packed mid-row embeds
+    exactly as it would alone at the row start — half of the per-document
+    oracle (the attention segment mask is the other half). The data
+    contract stays segment_ids-only; no position column is ever fed."""
     dt = cfg.dtype
     x_tok = tokens[:, :-1]
     l = x_tok.shape[1]
@@ -204,10 +307,26 @@ def _embed_tokens(params, tokens, cfg: LMConfig):
             f"packed batch carries {l} input tokens but cfg.max_len is "
             f"{cfg.max_len} (the packer's seq_len must match)"
         )
-    return (
-        params["embed"].astype(dt)[x_tok]
-        + params["pos"][:l].astype(dt)[None]
-    )
+    if segments is None:
+        pos = params["pos"][:l].astype(dt)[None]
+    else:
+        segs = segments[:, :-1]
+        idx = jnp.arange(l, dtype=jnp.int32)
+        # a segment starts where the id changes (position 0 always does);
+        # running cummax of the start indices = each position's segment
+        # start, so idx - start is the within-document position
+        boundary = jnp.concatenate(
+            [
+                jnp.ones((segs.shape[0], 1), bool),
+                segs[:, 1:] != segs[:, :-1],
+            ],
+            axis=1,
+        )
+        start = jax.lax.cummax(
+            jnp.where(boundary, idx[None, :], 0), axis=1
+        )
+        pos = params["pos"].astype(dt)[idx[None, :] - start]   # [B, L, D]
+    return params["embed"].astype(dt)[x_tok] + pos
 
 
 def _head_logits(params, x, cfg: LMConfig):
@@ -276,6 +395,8 @@ def forward(
     seq_axis: Optional[str] = None,
     pipe_axis: Optional[str] = None,
     expert_axis: Optional[str] = None,
+    fsdp_axis: Optional[str] = None,
+    segments: Optional[jax.Array] = None,
     diagnostics: bool = False,
 ):
     """tokens [B, L+1] int32 -> (logits [B, L, V] f32, aux f32[, diag]).
@@ -284,6 +405,19 @@ def forward(
     (module docstring); pipe and seq modes are mutually exclusive (a
     pipeline stage owns its devices — the sequence stays whole within
     it).
+
+    ``fsdp_axis`` adds GSPMD weight sharding to ANY of the other modes:
+    embed/pos/head gather on use here, each dense-loop block gathers its
+    own layer inside `_block` (peak unsharded weight residency = one
+    layer), and the pipeline mode needs no change at all — its
+    `pipeline_apply` param_spec (P(pipe)) boundary reshards each stage's
+    weights from the at-rest P(pipe, fsdp, ...) placement, which IS the
+    per-step gather-on-use, composed with stage slicing.
+
+    ``segments`` [B, L+1] int32 (TokenPacker bin modes) masks attention
+    across packed-document boundaries and switches to per-document
+    positions (`_embed_tokens`); not supported in the pipeline mode —
+    its stage stream carries activations only.
 
     ``diagnostics`` (a static flag — False compiles the exact pre-flag
     program) returns a third element: the in-jit model diagnostics dict
@@ -301,9 +435,25 @@ def forward(
         raise ValueError(
             "moe_experts > 0 is not supported in the pipeline mode"
         )
+    if pipe_axis is not None and segments is not None:
+        raise ValueError(
+            "segments are not supported in the pipeline mode: the stage "
+            "stream carries activations only (pack with the default "
+            "slice mode, or drop pipe_axis)"
+        )
     b = tokens.shape[0]
+    if fsdp_axis is not None:
+        # gather-on-use for the non-stacked params; the blocks gather
+        # per-layer in `_block` (dense loop) or at the pipeline_apply
+        # boundary (pipe mode)
+        g = _unshard_fn(mesh, fsdp_axis)
+        params = dict(params)
+        params["embed"] = g(params["embed"])
+        params["pos"] = g(params["pos"])
+        params["head"] = g(params["head"])
     # _embed_tokens owns the max_len validation
-    x = _embed_tokens(params, tokens, cfg)                     # [B, L, D]
+    x = _embed_tokens(params, tokens, cfg, segments=segments)  # [B, L, D]
+    segs_in = segments[:, :-1] if segments is not None else None
     aux_total = jnp.float32(0.0)
     diag: Dict[str, jax.Array] = {}
     if pipe_axis is not None:
@@ -332,6 +482,7 @@ def forward(
             x, aux, mdiag = _block(
                 layer, x, cfg, mesh=mesh, seq_axis=seq_axis,
                 data_axis=data_axis, expert_axis=expert_axis,
+                fsdp_axis=fsdp_axis, segments=segs_in,
                 diagnostics=diagnostics,
             )
             aux_total = aux_total + aux
@@ -358,21 +509,28 @@ def forward(
 
 def loss_fn(params, tokens, cfg: LMConfig, mesh=None, data_axis=None,
             seq_axis=None, pipe_axis=None, expert_axis=None,
-            diagnostics: bool = False):
-    """Mean next-token cross-entropy over every position of the packed
-    batch (packing leaves no padding) + the MoE aux loss. With
-    ``diagnostics`` returns (loss, diag) — the has_aux shape
-    value_and_grad wants."""
+            fsdp_axis=None, segments=None, diagnostics: bool = False):
+    """Mean next-token cross-entropy + the MoE aux loss. Without
+    ``segments`` every position scores (slice packing leaves no padding);
+    with them (bin packing) a position is valid only when the input token
+    and its target share a nonzero segment — no document's last token is
+    ever scored against the NEXT document's first, and pad positions
+    (segment 0) never contribute. With ``diagnostics`` returns
+    (loss, diag) — the has_aux shape value_and_grad wants."""
     out = forward(
         params, tokens, cfg, mesh, data_axis, seq_axis, pipe_axis,
-        expert_axis, diagnostics=diagnostics,
+        expert_axis, fsdp_axis=fsdp_axis, segments=segments,
+        diagnostics=diagnostics,
     )
     logits, aux = out[0], out[1]
     targets = tokens[:, 1:].astype(jnp.int32)
     logp = jax.nn.log_softmax(logits, axis=-1)
-    ce = -jnp.mean(
-        jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    )
+    tok_ce = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if segments is None:
+        ce = jnp.mean(tok_ce)
+    else:
+        valid = (segments[:, :-1] == segments[:, 1:]) & (segments[:, 1:] != 0)
+        ce = jnp.sum(tok_ce * valid) / jnp.maximum(valid.sum(), 1)
     loss = ce + cfg.moe_aux_weight * aux
     if diagnostics:
         return loss, out[2]
@@ -381,21 +539,40 @@ def loss_fn(params, tokens, cfg: LMConfig, mesh=None, data_axis=None,
 
 def train_step(params, opt_state, tokens, cfg: LMConfig, tx, mesh=None,
                data_axis=None, seq_axis=None, pipe_axis=None,
-               expert_axis=None, diagnostics: bool = False):
+               expert_axis=None, fsdp_axis=None, segments=None,
+               diagnostics: bool = False):
     """One optimizer step; jit this whole function (mesh static via
     closure/partial). Returns (params, opt_state, loss) — with
     ``diagnostics``, (params, opt_state, loss, diag): the in-jit model
     diagnostics ride the step's outputs, so reading them costs no extra
-    compilation or device round trip beyond fetching the tiny dict."""
+    compilation or device round trip beyond fetching the tiny dict.
+
+    With ``fsdp_axis`` the grads are constrained back to the parameter
+    layout (`param_specs`) right out of the backward pass: the optimizer
+    update and its state run SHARDED — cross-replica grad reduction goes
+    through a reduce+slice on the sharded layout, and no full all-gather
+    of grads ever exists in the step."""
     if diagnostics:
         (loss, diag), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params, tokens, cfg, mesh, data_axis, seq_axis, pipe_axis,
-            expert_axis, diagnostics=True,
+            expert_axis, fsdp_axis, segments, diagnostics=True,
         )
     else:
         loss, grads = jax.value_and_grad(loss_fn)(
             params, tokens, cfg, mesh, data_axis, seq_axis, pipe_axis,
-            expert_axis,
+            expert_axis, fsdp_axis, segments,
+        )
+    if mesh is not None and fsdp_axis is not None:
+        layout = SpecLayout(
+            fsdp_axis=fsdp_axis, pipe_axis=pipe_axis,
+            expert_axis=expert_axis,
+        )
+        grads = jax.tree.map(
+            lambda g, s: jax.lax.with_sharding_constraint(
+                g, NamedSharding(mesh, s)
+            ),
+            grads,
+            param_specs(grads, layout),
         )
     updates, opt_state = tx.update(grads, opt_state, params)
     params = jax.tree.map(lambda p, u: p + u, params, updates)
@@ -409,36 +586,27 @@ def param_shardings(
     params,
     pipe_axis: Optional[str] = None,
     expert_axis: Optional[str] = None,
+    fsdp_axis: Optional[str] = None,
 ):
-    """Replicate everything except what a mode shards: the stacked block
-    dim on ``pipe_axis`` (stage weights never replicate — that is PP), the
-    expert dim on ``expert_axis`` (that is EP).
+    """NamedShardings for the parameter pytree from the `SpecLayout` spec
+    table: the stacked block dim shards on ``pipe_axis`` (stage weights
+    never replicate — that is PP), the expert dim on ``expert_axis``
+    (EP), and every 2D+ weight's leading weight dim on ``fsdp_axis``
+    (FSDP at rest; the forward gathers on use). Axes left None degrade
+    to replication on that dim, so this is exactly the old behavior for
+    the old calls.
 
     The checkpoint keeps the canonical [n_layers, ...] stack under every
     mode; with ``cfg.n_virtual`` > 1 the forward's `_stage_stack` does
     the round-robin chunk relayout in-jit (XLA moves the weights once per
     step) — serving avoids even that by pre-placing the reshaped stack
     (LMStream)."""
-    repl = NamedSharding(mesh, P())
-
-    def blocks_spec(path_leaf):
-        return NamedSharding(mesh, P(pipe_axis)) if pipe_axis else repl
-
-    out = {
-        k: jax.tree.map(lambda _: repl, v)
-        for k, v in params.items()
-        if k != "blocks"
-    }
-    blocks = jax.tree.map(lambda _: blocks_spec(None), params["blocks"])
-    if expert_axis and "moe" in params["blocks"]:
-        # stacked moe leaves are [n_layers, E, ...]: expert dim is axis 1
-        blocks["moe"] = {
-            "router": repl,
-            "w_in": NamedSharding(mesh, P(pipe_axis, expert_axis, None, None)),
-            "w_out": NamedSharding(mesh, P(pipe_axis, expert_axis, None, None)),
-        }
-    out["blocks"] = blocks
-    return out
+    layout = SpecLayout(
+        fsdp_axis=fsdp_axis, pipe_axis=pipe_axis, expert_axis=expert_axis
+    )
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), param_specs(params, layout)
+    )
 
 
 def batch_shardings(mesh: Mesh, data_axis: str = "data"):

@@ -374,35 +374,70 @@ def make_global_batch(
 class TokenPacker:
     """Ragged token documents -> packed causal-LM batches [B, L+1] int32.
 
-    Documents are concatenated with an EOS separator and sliced into
-    non-overlapping windows of L+1 tokens (the consumer reads
-    ``row[:-1]`` and scores against ``row[1:]``), so every batch is fully
-    dense — no padding, no masks, maximal MXU utilization — the standard
-    packed-LM feed. The window boundary drops no tokens: the residual
-    tail carries into the next batch.
+    Three packing modes (the ``packing`` argument):
 
-    The carry (residual tokens + any already-packed-but-unpopped rows) is
-    the ONLY state, exposed via ``state()``/``restore()`` as a small JSON
-    payload, so a training job checkpoints it NEXT TO the dataset's
-    `IteratorState` and a kill -9/resume replays the packed stream
-    byte-identically (pinned by examples/train_lm.py's harness test).
+    - ``"slice"`` (default): documents are concatenated with an EOS
+      separator and sliced into non-overlapping windows of L+1 tokens
+      (the consumer reads ``row[:-1]`` and scores against ``row[1:]``),
+      so every batch is fully dense — no padding, no masks, maximal MXU
+      utilization — the standard packed-LM feed. The window boundary
+      drops no tokens (the residual tail carries into the next batch)
+      but DOES split documents across rows, and rows mix documents with
+      no boundary signal: attention leaks across documents.
+    - ``"first_fit"`` / ``"best_fit"``: bin packing. Each document (+
+      its EOS; documents longer than L+1 are pre-split into L+1-sized
+      chunks, each chunk its own segment) is placed whole into one of up
+      to B open row-bins of capacity L+1 — first_fit takes the
+      lowest-indexed bin it fits, best_fit the fitting bin with the
+      LEAST remaining room (ties to the lowest index). When a chunk fits
+      no bin and all B are open, the batch closes: rows pad to L+1 with
+      EOS and ``pop()`` returns ``{"tokens": [B, L+1], "segment_ids":
+      [B, L+1]}`` — ids number each row's documents 1..k in placement
+      order, pad positions are 0 — the block-diagonal mask feed for
+      `models.attention` ``segments``. Density (non-pad fraction,
+      ``density()``) is < 1 but no document ever crosses a row.
+
+    The carry (residual tokens / open bins + any already-packed-but-
+    unpopped rows) is the ONLY state, exposed via ``state()``/
+    ``restore()`` as a small JSON payload, so a training job checkpoints
+    it NEXT TO the dataset's `IteratorState` and a kill -9/resume
+    replays the packed stream byte-identically (pinned by
+    examples/train_lm.py's harness test).
     """
 
-    def __init__(self, batch_size: int, seq_len: int, eos_id: int = 0):
+    _MODES = ("slice", "first_fit", "best_fit")
+
+    def __init__(
+        self, batch_size: int, seq_len: int, eos_id: int = 0,
+        packing: str = "slice",
+    ):
         if batch_size < 1 or seq_len < 1:
             raise ValueError(
                 f"batch_size and seq_len must be >= 1, got "
                 f"({batch_size}, {seq_len})"
             )
+        if packing not in self._MODES:
+            raise ValueError(
+                f"packing must be one of {self._MODES}, got {packing!r}"
+            )
         self.batch_size = batch_size
         self.seq_len = seq_len
         self.eos_id = int(eos_id)
+        self.packing = packing
         self._buf: List[np.ndarray] = []   # chunks, flattened lazily
         self._buf_len = 0
-        self._pending: List[np.ndarray] = []  # ready [B, L+1] batches
+        # bin modes: open row-bins, each a list of document chunks
+        self._bins: List[List[np.ndarray]] = []
+        self._pending: List[Any] = []  # ready [B, L+1] batches / dicts
+        # density accounting (bin modes; slice mode is 1.0 by construction)
+        self._emitted_tokens = 0
+        self._emitted_nonpad = 0
 
     def feed_docs(self, docs: Iterable[np.ndarray]) -> None:
         """Append documents (1-D int arrays) to the stream, EOS after each."""
+        if self.packing != "slice":
+            self._feed_docs_bins(docs)
+            return
         eos = np.asarray([self.eos_id], np.int32)
         for doc in docs:
             arr = np.asarray(doc).astype(np.int32, copy=False).reshape(-1)
@@ -410,6 +445,67 @@ class TokenPacker:
             self._buf.append(eos)
             self._buf_len += arr.size + 1
         self._drain()
+
+    def _feed_docs_bins(self, docs: Iterable[np.ndarray]) -> None:
+        cap = self.seq_len + 1
+        eos = np.asarray([self.eos_id], np.int32)
+        for doc in docs:
+            arr = np.asarray(doc).astype(np.int32, copy=False).reshape(-1)
+            arr = np.concatenate([arr, eos])
+            # long documents pre-split into cap-sized chunks; each chunk
+            # is its own attention segment (they cannot share a row and
+            # attend to each other anyway)
+            for at in range(0, arr.size, cap):
+                self._place_chunk(arr[at : at + cap])
+
+    def _place_chunk(self, chunk: np.ndarray) -> None:
+        cap = self.seq_len + 1
+        fit = -1
+        if self.packing == "best_fit":
+            best_room = cap + 1
+            for i, b in enumerate(self._bins):
+                room = cap - sum(c.size for c in b)
+                if chunk.size <= room < best_room:
+                    fit, best_room = i, room
+        else:  # first_fit — the greedy binning baseline
+            for i, b in enumerate(self._bins):
+                if chunk.size <= cap - sum(c.size for c in b):
+                    fit = i
+                    break
+        if fit >= 0:
+            self._bins[fit].append(chunk)
+            return
+        if len(self._bins) == self.batch_size:
+            self._close_bins()
+        self._bins.append([chunk])
+
+    def _close_bins(self) -> None:
+        """Flush the B open bins into one pending {tokens, segment_ids}
+        batch: rows pad to L+1 with EOS, pad segment id 0."""
+        cap = self.seq_len + 1
+        toks = np.full((self.batch_size, cap), self.eos_id, np.int32)
+        segs = np.zeros((self.batch_size, cap), np.int32)
+        nonpad = 0
+        for r, b in enumerate(self._bins):
+            at = 0
+            for s, chunk in enumerate(b):
+                toks[r, at : at + chunk.size] = chunk
+                segs[r, at : at + chunk.size] = s + 1
+                at += chunk.size
+            nonpad += at
+        self._bins = []
+        self._pending.append({"tokens": toks, "segment_ids": segs})
+        self._emitted_tokens += self.batch_size * cap
+        self._emitted_nonpad += nonpad
+        METRICS.gauge("pack.density", round(self.density(), 4))
+
+    def density(self) -> float:
+        """Fraction of emitted batch tokens that are real document tokens
+        (1.0 until a bin-mode batch closes; slice mode is 1.0 always —
+        the window slicing leaves no padding)."""
+        if not self._emitted_tokens:
+            return 1.0
+        return self._emitted_nonpad / self._emitted_tokens
 
     def feed_column(self, col) -> None:
         """Feed a ragged int Column straight from a ColumnarBatch: the
@@ -438,28 +534,62 @@ class TokenPacker:
         self._buf = [rest] if rest.size else []
         self._buf_len = int(rest.size)
 
-    def pop(self) -> Optional[np.ndarray]:
-        """Next ready [B, L+1] batch, or None when more docs are needed."""
+    def pop(self):
+        """Next ready batch, or None when more docs are needed: a
+        [B, L+1] int32 array in slice mode, a ``{"tokens": [B, L+1],
+        "segment_ids": [B, L+1]}`` dict in the bin modes."""
         return self._pending.pop(0) if self._pending else None
 
     def state(self) -> dict:
         """JSON-able carry: checkpoint it WITH the dataset IteratorState
-        taken at the same point so resume replays byte-identically."""
-        flat = (
-            np.concatenate(self._buf).tolist() if self._buf else []
-        )
+        taken at the same point so resume replays byte-identically. Slice
+        mode keeps its historical {residual, pending} shape (old
+        checkpoints restore unchanged); bin modes carry the open bins
+        (per-row chunk lists), the pending {tokens, segment_ids} dicts,
+        and the density accumulators."""
+        if self.packing == "slice":
+            flat = (
+                np.concatenate(self._buf).tolist() if self._buf else []
+            )
+            return {
+                "residual": flat,
+                "pending": [b.tolist() for b in self._pending],
+            }
         return {
-            "residual": flat,
-            "pending": [b.tolist() for b in self._pending],
+            "bins": [[c.tolist() for c in b] for b in self._bins],
+            "pending": [
+                {
+                    "tokens": d["tokens"].tolist(),
+                    "segment_ids": d["segment_ids"].tolist(),
+                }
+                for d in self._pending
+            ],
+            "emitted_tokens": self._emitted_tokens,
+            "emitted_nonpad": self._emitted_nonpad,
         }
 
     def restore(self, state: dict) -> None:
-        residual = np.asarray(state.get("residual", []), np.int32)
-        self._buf = [residual] if residual.size else []
-        self._buf_len = int(residual.size)
-        self._pending = [
-            np.asarray(b, np.int32) for b in state.get("pending", [])
+        if self.packing == "slice":
+            residual = np.asarray(state.get("residual", []), np.int32)
+            self._buf = [residual] if residual.size else []
+            self._buf_len = int(residual.size)
+            self._pending = [
+                np.asarray(b, np.int32) for b in state.get("pending", [])
+            ]
+            return
+        self._bins = [
+            [np.asarray(c, np.int32) for c in b]
+            for b in state.get("bins", [])
         ]
+        self._pending = [
+            {
+                "tokens": np.asarray(d["tokens"], np.int32),
+                "segment_ids": np.asarray(d["segment_ids"], np.int32),
+            }
+            for d in state.get("pending", [])
+        ]
+        self._emitted_tokens = int(state.get("emitted_tokens", 0))
+        self._emitted_nonpad = int(state.get("emitted_nonpad", 0))
 
 
 class HostPrefetcher:

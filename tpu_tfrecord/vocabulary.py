@@ -189,6 +189,8 @@ GAUGES: Dict[str, str] = {
     "train.share.compute": "windowed share of step wall in compute",
     "train.share.ckpt": "windowed share of step wall in checkpointing",
     "ckpt.inflight": "background checkpoint commits in flight (0 or 1)",
+    "pack.density": "fraction of emitted packed tokens that are real (bin modes)",
+    "lm.fsdp_param_bytes": "per-device at-rest param bytes under the fsdp layout",
     "moe.dropped_fraction": "latest per-step dropped-token fraction",
     "moe.gate_entropy": "latest per-step router gate entropy",
     "moe.expert_imbalance": "latest per-step expert imbalance",
@@ -234,6 +236,7 @@ DYNAMIC_PREFIXES: Dict[str, Dict[str, str]] = {
     "gauge": {
         "autotune.": "one gauge per tuned knob (workers, prefetch, ...)",
         "train.share.": "one gauge per train phase",
+        "train.mesh.": "one gauge per mesh axis (extent)",
     },
     "stage": {
         "train.": "one stage per train phase",
