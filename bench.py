@@ -1866,6 +1866,17 @@ def _serving_child() -> None:
             "shed_rate": round(shed / max(1, offered), 3),
             "p50_ms": round(rep["p50_ms"], 2),
             "p99_ms": round(rep["p99_ms"], 2),
+            # request-latency decomposition from the serve.queue_wait /
+            # serve.service spans (ISSUE 20): where the p99 lives —
+            # waiting for a slot, or being computed
+            "queue_wait_p99_ms": (
+                round(rep["queue_wait_p99_ms"], 2)
+                if rep.get("queue_wait_p99_ms") is not None else None
+            ),
+            "service_p99_ms": (
+                round(rep["service_p99_ms"], 2)
+                if rep.get("service_p99_ms") is not None else None
+            ),
             "verdict": rep["verdict"],
         }
 
@@ -1878,11 +1889,24 @@ def _serving_child() -> None:
     # off saturation throughput sheds when it should cruise
     overload = open_loop(3.0 * capacity, 2.0)
     steady = open_loop(0.2 * overload["requests_per_s"], 2.5)
+    from tpu_tfrecord.slo import burn_rate
+
     out = {
         # headline pair (banded in _PREV_NOISE_BANDS): latency where the
         # SLO lives, throughput where the capacity lives
         "serve_p99_ms": steady["p99_ms"],
         "serve_requests_per_s": overload["requests_per_s"],
+        # the p99 decomposed: queue wait vs service time at steady state
+        "serve_queue_wait_p99_ms": steady["queue_wait_p99_ms"],
+        "serve_service_p99_ms": steady["service_p99_ms"],
+        # availability (0.999) burn rate at steady state — ~0 when the
+        # engine cruises at 0.5x capacity; any sustained value means the
+        # steady leg started shedding, a capacity regression the p99
+        # alone can hide (the overload leg's ~2/3 shed rate is design,
+        # so only the steady leg's burn is a signal)
+        "serve_error_budget_burn": round(
+            burn_rate(steady["shed"], steady["offered"], 0.999), 2
+        ),
         "serving": {
             "capacity_requests_per_s": round(capacity, 1),
             "steady": steady,
@@ -2103,6 +2127,13 @@ _PREV_NOISE_BANDS = {
     # rename diffs across meanings and will flag; ignore that one flag.
     "serve_requests_per_s": 0.50,
     "serve_p99_ms": 0.50,
+    # ISSUE 20: the p99 decomposition (same shared-box noise as the p99
+    # itself) and the steady-leg error-budget burn — the burn sits at 0
+    # when healthy, so ratio noise is meaningless; the wide band only
+    # fires when steady-state shedding appears outright
+    "serve_queue_wait_p99_ms": 0.50,
+    "serve_service_p99_ms": 0.50,
+    "serve_error_budget_burn": 2.00,
     "remote_http_cold_value": 0.50,
     "remote_http_cached_value": 0.35,
     "seq_host_value": 0.25,
@@ -2136,6 +2167,9 @@ _SMALLER_IS_BETTER = {
     "ckpt_commit_p99_ms_npz",
     "ckpt_commit_p99_ms_state",
     "serve_p99_ms",
+    "serve_queue_wait_p99_ms",
+    "serve_service_p99_ms",
+    "serve_error_budget_burn",
 }
 
 

@@ -278,6 +278,157 @@ class TestEngineParity:
 
 
 # ---------------------------------------------------------------------------
+# Request-scoped tracing (ISSUE 20): root spans, children, exemplars
+# ---------------------------------------------------------------------------
+
+
+class TestRequestTracing:
+    @pytest.fixture(autouse=True)
+    def _recorder(self):
+        """The span recorder is process-global: scrub it around every
+        tracing test so neither direction leaks spans."""
+        telemetry.RECORDER.clear()
+        telemetry.enable()
+        yield
+        telemetry.disable()
+        telemetry.RECORDER.clear()
+
+    def test_one_root_span_per_admitted_request_exact_duration(self, model):
+        """THE tracing pin: a seeded run shaped like the acceptance
+        criterion (4 served requests, one cancel = client disconnect, one
+        in-flight deadline expiry) yields EXACTLY one `serve.request`
+        span per admitted request, with t0 = admission and duration =
+        admission -> completion EXACTLY on the engine's fake clock; the
+        latency histogram's tail exemplar resolves to one of those
+        spans."""
+        params, cfg, mesh = model
+        metrics = Metrics()
+        clock = FakeClock()
+        eng = ServingEngine(
+            params, cfg, mesh, policy=ServePolicy(mb=MB, max_queue=32),
+            metrics=metrics, clock=clock,
+        )
+        ws = _windows(6, seed=20)
+        finishes = {}
+
+        def on_done(req):
+            # the engine clock is frozen within a tick, so clock() here
+            # IS the `now` _finish stamped into the span
+            finishes[req.rid] = (req.status, clock())
+
+        admitted = []
+        for i, w in enumerate(ws[:4]):
+            clock.advance(0.125)  # staggered admissions: distinct births
+            admitted.append(eng.submit(w, 1 + i % 3, on_done=on_done))
+        gone = eng.submit(ws[4], 3, on_done=on_done)
+        doomed = eng.submit(ws[5], 3, deadline_s=1.5, on_done=on_done)
+        admitted += [gone, doomed]
+        eng.cancel(gone)        # disconnects before ever claiming a slot
+        assert eng.step() == 4  # tick 1: the four serveable slots
+        clock.advance(2.0)      # the in-flight deadline passes
+        eng.run_until_idle()
+        eng.stop()
+
+        assert finishes[gone.rid][0] == "cancelled"
+        assert finishes[doomed.rid][0] == "deadline_expired"
+        spans = [
+            s for s in telemetry.RECORDER.spans() if s[0] == "serve.request"
+        ]
+        assert len(spans) == len(admitted) == 6
+        by_sid = {s[4]["span_id"]: s for s in spans}
+        assert len(by_sid) == 6, "span ids must be unique per request"
+        for req in admitted:
+            _, t0_ns, dur_ns, _, attrs, ph = by_sid[req.span_id]
+            status, done_t = finishes[req.rid]
+            assert ph == "X"
+            assert t0_ns == int(req.birth * 1e9)
+            assert dur_ns == int((done_t - req.birth) * 1e9)
+            assert attrs["status"] == status
+            assert attrs["trace_id"] == req.trace_id
+            assert attrs["rid"] == req.rid
+
+        # every SERVED request carries a queue_wait child and >=1 tick
+        # child parented under its span id; the refused two carry none
+        children = {}
+        for s in telemetry.RECORDER.spans():
+            parent = (s[4] or {}).get("parent_span_id")
+            if s[0] in ("serve.queue_wait", "serve.tick") and parent:
+                children.setdefault(parent, set()).add(s[0])
+        for req in admitted[:4]:
+            assert children[req.span_id] == {
+                "serve.queue_wait", "serve.tick"
+            }
+        assert gone.span_id not in children
+        assert doomed.span_id not in children
+
+        # the fleet-mergeable latency histogram's p99 exemplar names a
+        # recorded request span, and its value is that span's duration
+        merged = telemetry.Histogram.from_states(
+            [metrics.hist_states()["serve.latency"]]
+        )
+        ex = merged.exemplar_at(0.99)
+        assert ex is not None
+        assert ex["span_id"] in by_sid
+        root = by_sid[ex["span_id"]]
+        assert root[4]["trace_id"] == ex["trace_id"]
+        assert ex["value"] == pytest.approx(root[2] / 1e9)
+
+    def test_admission_refusals_land_attributable_instants(self, model):
+        """A refused request never gets a root span (it was never
+        admitted) but its shed/expiry instant carries the trace identity,
+        so it is still attributable in a merged timeline."""
+        params, cfg, mesh = model
+        clock = FakeClock()
+        eng = ServingEngine(
+            params, cfg, mesh, policy=ServePolicy(mb=MB, max_queue=1),
+            metrics=Metrics(), clock=clock,
+        )
+        ws = _windows(3, seed=21)
+        eng.submit(ws[0], 1)
+        with pytest.raises(ServeRejected):
+            eng.submit(ws[1], 1)
+        with pytest.raises(DeadlineExpired):
+            eng.submit(ws[2], 1, deadline_s=0.0)
+        events = telemetry.RECORDER.spans()
+        sheds = [s for s in events if s[0] == "serve.shed"]
+        expiries = [s for s in events if s[0] == "serve.deadline_expired"]
+        assert len(sheds) == 1
+        assert sheds[0][5] == "i"
+        assert sheds[0][4]["reason"] == "queue_full"
+        assert sheds[0][4]["trace_id"] and sheds[0][4]["span_id"]
+        assert len(expiries) == 1
+        assert expiries[0][4]["at"] == "admission"
+        eng.run_until_idle()
+        requests = [
+            s for s in telemetry.RECORDER.spans() if s[0] == "serve.request"
+        ]
+        assert len(requests) == 1  # only the admitted one
+        eng.stop()
+
+    def test_byte_parity_unchanged_with_tracing_enabled(self, model):
+        """The serving parity pin holds verbatim with the recorder ON:
+        tracing is observation, never perturbation."""
+        params, cfg, mesh = model
+        metrics = Metrics()
+        eng = ServingEngine(
+            params, cfg, mesh, policy=ServePolicy(mb=MB, max_queue=32),
+            metrics=metrics,
+        )
+        reqs = [(w, 1 + i % 3) for i, w in enumerate(_windows(7, seed=3))]
+        handles = [eng.submit(w, n) for w, n in reqs]
+        eng.run_until_idle()
+        ref = sequential_reference(params, cfg, mesh, reqs, MB)
+        for h, want in zip(handles, ref):
+            assert h.result(timeout=0) == want
+        assert metrics.counter("serve.requests") == 7
+        roots = [
+            s for s in telemetry.RECORDER.spans() if s[0] == "serve.request"
+        ]
+        assert len(roots) == 7
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
 # Socket tier: concurrent clients, disconnect chaos, drain
 # ---------------------------------------------------------------------------
 

@@ -20,10 +20,11 @@ Usage::
     tools/tfrecord_doctor.py fleet SPOOL_DIR              # cluster doctor
     tools/tfrecord_doctor.py train SPOOL_DIR              # training doctor
     tools/tfrecord_doctor.py serve SPOOL_DIR              # serving doctor
+    tools/tfrecord_doctor.py slo SPOOL_DIR                # error-budget doctor
     tools/tfrecord_doctor.py merge-trace OUT F1 F2 ...    # fuse Perfetto traces
 
-``fleet``, ``train``, ``serve``, and ``serve-status`` accept ``--json``:
-the same
+``fleet``, ``train``, ``serve``, ``slo``, and ``serve-status`` accept
+``--json``: the same
 event objects, in the same order, as ONE machine-readable JSON document
 ``{"events": [...]}`` instead of one object per line (exit codes
 unchanged — pinned by round-trip tests).
@@ -81,6 +82,17 @@ filling admission queue — add replicas), ``compute_bound`` (missing SLO
 with an empty queue — faster model/hardware, not more replicas). Exit
 0 = report (an overloaded tier is a finding), 2 = no serving spools.
 
+The ``slo`` subcommand is the ERROR-BUDGET doctor (tpu_tfrecord.slo): it
+replays a spool directory's whole cumulative history into the SLO engine
+and prints one ``{"event": "objective", ...}`` line per declared
+objective (``--objective availability:0.999`` /
+``latency:0.95:250``, repeatable; both by default) with budget remaining
+and the fast/slow multi-window burn rates, plus a final
+``{"event": "slo", ...}`` line whose verdict is
+``healthy`` / ``slow_burn`` / ``fast_burn`` — "are we burning the error
+budget fast enough to page someone", not "is p99 high right now". Exit
+0 = report; 2 = no spool snapshots.
+
 The ``serve-status`` subcommand is the data-service doctor
 (tpu_tfrecord.service): one status round trip to a dispatcher prints one
 ``{"event": "worker", ...}`` line per registered decode worker (liveness
@@ -95,7 +107,9 @@ workers are a finding), 2 = dispatcher unreachable.
 (``save_chrome_trace`` output) into one Perfetto timeline with a labeled
 track per process (telemetry.merge_chrome_traces) — pid collisions
 across hosts are remapped, every process renders under its
-``role@host:pid`` label.
+``role@host:pid`` label. A DIRECTORY argument stands for every
+``*.json`` inside it, sorted — ``merge-trace merged.json traces/``
+fuses a whole run's trace drop without hand-globbing.
 
 The ``cache`` subcommand audits a columnar epoch cache directory
 (tpu_tfrecord.cache): one ``{"event": "cache_entry", ...}`` line per entry
@@ -1222,7 +1236,157 @@ def _serve_report(args, emit) -> int:
         q = merged_latency.quantiles()
         summary["latency_p50_ms"] = round(q["p50_s"] * 1e3, 3)
         summary["latency_p99_ms"] = round(q["p99_s"] * 1e3, 3)
+        # "p99 exemplar: trace=… span=…" — the clickable pointer from the
+        # fleet tail back to the request trace that filled it
+        ex = merged_latency.exemplar_at(0.99)
+        if ex is not None:
+            summary["p99_exemplar"] = {
+                "trace": ex["trace_id"],
+                "span": ex["span_id"],
+                "value_ms": round(ex["value"] * 1e3, 3),
+            }
+    # error-budget state rides ADDITIVE summary fields: the point-p99
+    # "verdict" keeps its pinned value set, "error_budget" upgrades it to
+    # budget-remaining + burn-rate terms (tpu_tfrecord.slo) computed from
+    # the spool's full history against the same --slo-ms target
+    from tpu_tfrecord import slo as _slo
+
+    try:
+        engine = _slo.engine_from_spool(
+            args.spool_dir,
+            objectives=(
+                _slo.Objective(kind="availability", target=0.999),
+                _slo.Objective(
+                    kind="latency", target=0.95, latency_ms=args.slo_ms
+                ),
+            ),
+            trace_id=args.trace_id,
+            clock=agg._clock,
+        )
+    except OSError:
+        engine = None
+    if engine is not None:
+        budget = engine.evaluate(now)
+        summary["error_budget"] = {
+            "verdict": budget["verdict"],
+            "objectives": {
+                e["objective"]: {
+                    "budget_remaining": round(e["budget_remaining"], 4),
+                    "verdict": e["verdict"],
+                }
+                for e in budget["objectives"]
+            },
+        }
     emit(summary)
+    return 0
+
+
+def slo_main(argv: List[str]) -> int:
+    """The ``slo`` subcommand: the error-budget doctor. Replays a spool
+    directory's cumulative history into tpu_tfrecord.slo's multi-window
+    multi-burn-rate engine: one ``{"event": "objective", ...}`` line per
+    declared objective (budget remaining, fast/slow window burn rates,
+    per-objective verdict) and one final ``{"event": "slo", ...}`` line
+    with the worst verdict (``healthy`` / ``slow_burn`` / ``fast_burn``).
+    Exit 0 = report produced (a burning budget is a finding, not a
+    failure); 2 = unreadable spool dir, bad objective spec, or no spool
+    snapshots."""
+    ap = argparse.ArgumentParser(
+        prog="tfrecord_doctor slo",
+        description="Error-budget doctor: multi-window multi-burn-rate "
+        "SLO verdict from a telemetry spool directory",
+    )
+    ap.add_argument("spool_dir", help="telemetry spool directory")
+    ap.add_argument(
+        "--objective", action="append", default=None, metavar="SPEC",
+        help="objective spec, repeatable: availability:TARGET or "
+        "latency:TARGET:MS (default: availability:0.999 and "
+        "latency:0.95:250)",
+    )
+    ap.add_argument(
+        "--trace-id", default=None, metavar="ID",
+        help="only read spool files from this run",
+    )
+    ap.add_argument(
+        "--window-scale", type=float, default=1.0, metavar="X",
+        help="multiply every burn-window length by X (tests shrink the "
+        "1h/5m + 6h/30m defaults to fake-clock scale; thresholds are "
+        "untouched)",
+    )
+    ap.add_argument(
+        "--now", type=float, default=None, metavar="UNIX_TS",
+        help="evaluate as of this wall-clock time instead of now "
+        "(deterministic replays of an archived spool)",
+    )
+    _add_json_flag(ap)
+    args = ap.parse_args(argv)
+
+    emit = _Emitter(args.json)
+    try:
+        return _slo_report(args, emit)
+    finally:
+        emit.close()
+
+
+def _slo_report(args, emit) -> int:
+    from tpu_tfrecord import slo as _slo
+
+    try:
+        objectives = (
+            tuple(_slo.Objective.parse(s) for s in args.objective)
+            if args.objective
+            else _slo.DEFAULT_OBJECTIVES
+        )
+    except ValueError as e:
+        emit({"event": "error", "error": str(e)})
+        return 2
+    windows = tuple(
+        w.scaled(args.window_scale) for w in _slo.DEFAULT_WINDOWS
+    )
+    try:
+        engine = _slo.engine_from_spool(
+            args.spool_dir,
+            objectives=objectives,
+            windows=windows,
+            trace_id=args.trace_id,
+        )
+    except OSError as e:
+        emit({"event": "error", "path": args.spool_dir, "error": str(e)})
+        return 2
+    if engine is None:
+        emit({
+            "event": "error", "path": args.spool_dir,
+            "error": "no spool snapshots found",
+        })
+        return 2
+    report = engine.evaluate(args.now)
+    for entry in report["objectives"]:
+        emit({
+            "event": "objective",
+            "objective": entry["objective"],
+            "kind": entry["kind"],
+            "target": entry["target"],
+            "bad": entry["bad"],
+            "total": entry["total"],
+            "budget_remaining": round(entry["budget_remaining"], 4),
+            "windows": [
+                {
+                    "name": w["name"],
+                    "threshold": w["threshold"],
+                    "long_burn": round(w["long_burn"], 3),
+                    "short_burn": round(w["short_burn"], 3),
+                    "alerting": w["alerting"],
+                }
+                for w in entry["windows"]
+            ],
+            "verdict": entry["verdict"],
+        })
+    emit({
+        "event": "slo",
+        "path": args.spool_dir,
+        "objectives": [o.spec for o in objectives],
+        "verdict": report["verdict"],
+    })
     return 0
 
 
@@ -1235,7 +1399,11 @@ def merge_trace_main(argv: List[str]) -> int:
         "pid-labeled Perfetto timeline",
     )
     ap.add_argument("out", help="merged trace output path")
-    ap.add_argument("traces", nargs="+", help="per-process trace JSON files")
+    ap.add_argument(
+        "traces", nargs="+",
+        help="per-process trace JSON files; a directory stands for every "
+        "*.json inside it, sorted",
+    )
     args = ap.parse_args(argv)
 
     from tpu_tfrecord import telemetry
@@ -1243,8 +1411,26 @@ def merge_trace_main(argv: List[str]) -> int:
     def emit(obj: Dict) -> None:
         sys.stdout.write(json.dumps(obj, sort_keys=True) + "\n")
 
+    traces: List[str] = []
+    for path in args.traces:
+        if os.path.isdir(path):
+            inside = sorted(
+                os.path.join(path, name)
+                for name in os.listdir(path)
+                if name.endswith(".json")
+            )
+            if not inside:
+                emit({
+                    "event": "error", "path": path,
+                    "error": "directory holds no *.json traces",
+                })
+                return 2
+            traces.extend(inside)
+        else:
+            traces.append(path)
+
     try:
-        merged = telemetry.merge_chrome_traces(args.out, args.traces)
+        merged = telemetry.merge_chrome_traces(args.out, traces)
     except (OSError, ValueError) as e:
         emit({"event": "error", "path": args.out, "error": str(e)})
         return 2
@@ -1255,7 +1441,7 @@ def merge_trace_main(argv: List[str]) -> int:
         {
             "event": "merged_trace",
             "path": args.out,
-            "inputs": len(args.traces),
+            "inputs": len(traces),
             "pids": len(pids),
             "events": len(merged["traceEvents"]),
         }
@@ -1356,6 +1542,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return train_main(argv[1:])
     if argv and argv[0] == "serve":
         return serve_main(argv[1:])
+    if argv and argv[0] == "slo":
+        return slo_main(argv[1:])
     if argv and argv[0] == "serve-status":
         return serve_status_main(argv[1:])
     if argv and argv[0] == "merge-trace":

@@ -914,6 +914,139 @@ print("serving-tier smoke OK:", json.dumps({
 }))
 PY
 
+echo "== SLO + request-tracing smoke (traced replica, 4 clients + injected deadline expiry -> doctor slo burn verdict; merged trace has one serve.request per admitted request) =="
+# ISSUE 20 end-to-end: a --trace-out replica under 4 concurrent clients
+# plus ONE request submitted with an already-expired deadline. The spool's
+# history must drive `tfrecord_doctor slo` to exit 0 with a burn-rate
+# verdict on the availability objective (1 expiry against 4 completions
+# burns far past the 14.4x fast threshold), and `merge-trace` pointed at
+# the TRACE DIRECTORY must produce a timeline holding exactly one
+# serve.request root span per admitted request, each with a
+# serve.queue_wait child and >= 1 serve.tick slice under the same
+# client-minted span id.
+env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python - <<'PY' || exit 1
+import json, os, signal, subprocess, sys, tempfile, threading
+
+import numpy as np
+
+root = tempfile.mkdtemp(prefix="tfr_slo_smoke_")
+spool = os.path.join(root, "spool")
+traces = os.path.join(root, "traces")
+os.makedirs(traces)
+
+srv = subprocess.Popen(
+    [sys.executable, "-m", "tpu_tfrecord.serving", "--seed", "0",
+     "--spool-dir", spool,
+     "--trace-out", os.path.join(traces, "replica.json")],
+    stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+)
+try:
+    ready = json.loads(srv.stdout.readline())
+    addr = ready["addr"]
+
+    from tpu_tfrecord import telemetry
+    from tpu_tfrecord.serving import DeadlineExpired, ServeClient
+
+    telemetry.enable()  # the client half of the merged timeline
+    rng = np.random.default_rng(7)
+    windows = [
+        rng.integers(1, 96, size=16).astype(np.int32) for _ in range(4)
+    ]
+    results, errors = {}, []
+
+    def client(i):
+        c = ServeClient([addr])
+        try:
+            results[i] = c.generate(windows[i], n_new=3)
+        except Exception as e:  # noqa: BLE001
+            errors.append((i, repr(e)))
+        finally:
+            c.close()
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    assert sorted(results) == [0, 1, 2, 3], sorted(results)
+
+    # the injected deadline expiry: already unmeetable at admission, so
+    # it is REFUSED (never admitted -> no serve.request span) but counted
+    # into serve.deadline_expired — the availability objective's burn
+    expired = ServeClient([addr])
+    try:
+        expired.generate(windows[0], n_new=3, deadline_s=0.0)
+        raise AssertionError("deadline_s=0 request was served")
+    except DeadlineExpired:
+        pass
+    finally:
+        expired.close()
+
+    telemetry.RECORDER.save_chrome_trace(os.path.join(traces, "clients.json"))
+    telemetry.disable()
+
+    srv.send_signal(signal.SIGTERM)  # graceful drain -> final spool line
+    out, err = srv.communicate(timeout=60)
+    assert srv.returncode == 0, (srv.returncode, out[-2000:], err[-2000:])
+finally:
+    if srv.poll() is None:
+        srv.kill()
+        srv.wait()
+
+# doctor slo: exit 0, the availability objective named, burning fast
+doc = subprocess.run(
+    [sys.executable, "tools/tfrecord_doctor.py", "slo", spool, "--json"],
+    capture_output=True, text=True, timeout=120,
+)
+assert doc.returncode == 0, (doc.returncode, doc.stdout, doc.stderr)
+events = json.loads(doc.stdout)["events"]
+avail = [
+    e for e in events
+    if e["event"] == "objective" and e["kind"] == "availability"
+]
+assert len(avail) == 1, events
+assert avail[0]["objective"] == "availability:0.999", avail
+assert avail[0]["bad"] >= 1 and avail[0]["total"] >= 5, avail
+assert avail[0]["verdict"] == "fast_burn", avail
+summary = [e for e in events if e["event"] == "slo"][-1]
+assert summary["verdict"] == "fast_burn", summary
+
+# merge-trace on the DIRECTORY: one serve.request per admitted request,
+# each with its queue_wait child and >= 1 tick slice
+merged_path = os.path.join(root, "merged.json")
+mt = subprocess.run(
+    [sys.executable, "tools/tfrecord_doctor.py", "merge-trace",
+     merged_path, traces],
+    capture_output=True, text=True, timeout=120,
+)
+assert mt.returncode == 0, (mt.returncode, mt.stdout, mt.stderr)
+with open(merged_path) as fh:
+    merged = json.load(fh)
+evs = merged["traceEvents"]
+reqs = [e for e in evs if e.get("name") == "serve.request"]
+assert len(reqs) == 4, [e.get("name") for e in evs][:40]
+span_ids = {e["args"]["span_id"] for e in reqs}
+assert len(span_ids) == 4, reqs
+for sid in span_ids:
+    kids = [
+        e for e in evs
+        if e.get("args", {}).get("parent_span_id") == sid
+    ]
+    names = [e["name"] for e in kids]
+    assert "serve.queue_wait" in names, (sid, names)
+    assert names.count("serve.tick") >= 1, (sid, names)
+expiries = [e for e in evs if e.get("name") == "serve.deadline_expired"]
+assert len(expiries) >= 1, "injected expiry left no instant"
+print("slo smoke OK:", json.dumps({
+    "availability_verdict": avail[0]["verdict"],
+    "budget_remaining": avail[0]["budget_remaining"],
+    "request_spans": len(reqs),
+    "merged_events": len(evs),
+}))
+PY
+
 echo "== async-ckpt smoke (seeded slow disk, SIGKILL mid-commit -> resume from complete generation, non-ckpt_bound) =="
 # ISSUE 16 end-to-end: train_lm under a seeded commit throttle (the
 # slow-disk fault). The kill leg SIGKILLs right after step 9 — the step-8

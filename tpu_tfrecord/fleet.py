@@ -72,6 +72,7 @@ __all__ = [
     "release_spool",
     "ProcessSnapshot",
     "read_spool",
+    "read_spool_history",
     "FleetSnapshot",
     "TelemetryAggregator",
     "train_phase_shares",
@@ -447,6 +448,37 @@ def read_spool(path: str) -> Optional[ProcessSnapshot]:
     return None
 
 
+def read_spool_history(path: str) -> List[ProcessSnapshot]:
+    """Parse EVERY valid line of one spool file, oldest first — the
+    windowed time series the SLO engine's burn-rate math needs (each line
+    is a cumulative snapshot stamped with the writer's ``ts``, so
+    consecutive lines difference into per-interval deltas). Same skip
+    semantics as ``read_spool``: invalid lines are dropped, never fatal;
+    an unreadable file is an empty history. Each snapshot's ``heartbeat``
+    carries its own line's timestamp (cumulative-at-that-moment), and
+    ``skipped_lines`` on the last snapshot counts the file's bad lines."""
+    try:
+        with open(path, "rb") as fh:
+            raw_lines = fh.read().splitlines()
+    except OSError:
+        return []
+    out: List[ProcessSnapshot] = []
+    skipped = 0
+    for raw in raw_lines:
+        if not raw.strip():
+            continue
+        try:
+            snap = _snapshot_from_line(path, json.loads(raw))
+        except (ValueError, TypeError, KeyError, AttributeError):
+            skipped += 1
+            continue
+        out.append(snap)
+    if out:
+        out[-1].lines = len(out)
+        out[-1].skipped_lines = skipped
+    return out
+
+
 @dataclass
 class FleetSnapshot:
     """One merged cluster-level view over every process in a spool dir."""
@@ -686,6 +718,24 @@ class TelemetryAggregator:
                     if telemetry.is_latency_hist(name)
                 ),
             ),
+        )
+        # Exemplars ride a dedicated gauge family (value = the exemplared
+        # observation in seconds) rather than OpenMetrics `# {...}` sample
+        # suffixes: the text-format 0.0.4 parsers the existing pages pin
+        # would reject the suffix syntax. `le` carries the bucket's upper
+        # bound so a dashboard can join an exemplar to the quantile family.
+        family(
+            "tfrecord_fleet_latency_exemplar_seconds",
+            "gauge",
+            [
+                "tfrecord_fleet_latency_exemplar_seconds{"
+                f'stage="{esc(name)}",le="{Histogram.bucket_le(idx):.6g}",'
+                f'trace_id="{esc(t)}",span_id="{esc(s)}"'
+                "} " + f"{v:.6g}"
+                for name, h in sorted(snap.hists.items())
+                if telemetry.is_latency_hist(name)
+                for idx, (t, s, v) in sorted(h.exemplars.items())
+            ],
         )
         return "\n".join(lines) + "\n"
 

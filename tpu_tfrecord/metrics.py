@@ -18,7 +18,16 @@ Three value kinds live in one registry (distinct storage, one lock):
   per-op latency distributions (tpu_tfrecord.telemetry.Histogram) so
   p50/p90/p99 sit next to the totals and stragglers stop hiding inside
   means. ``timed`` feeds them automatically — one observation per timed
-  block, same lock acquisition as the totals update.
+  block, same lock acquisition as the totals update. ``add``/``observe``
+  take an optional ``exemplar=(trace_id, span_id)`` that tags the
+  observation's bucket (the pointer from a fleet p99 back to the request
+  trace that produced it — see telemetry.Histogram.exemplar_at).
+
+Cumulative registries compose upward: the fleet spool ships
+``raw_totals()`` + ``hist_states()`` per interval, and the SLO engine
+(tpu_tfrecord.slo.SloEngine) folds those cumulative snapshots into its
+bounded ring of windowed samples for multi-window burn-rate alerts —
+this registry stays cheap and monotonic, windowing lives downstream.
 
 Every name passed to these calls must be registered in
 ``tpu_tfrecord.vocabulary`` (the single owner of the metric/span name
@@ -78,11 +87,15 @@ class Metrics:
         nbytes: int = 0,
         seconds: float = 0.0,
         latency: Optional[float] = None,
+        exemplar: Optional[Tuple[str, str]] = None,
     ) -> None:
         """Accumulate into a stage's totals. ``latency`` additionally folds
         one observation into the stage's latency histogram under the SAME
         lock acquisition (``timed`` passes its elapsed time here, so every
-        timed stage grows a p50/p90/p99 for free)."""
+        timed stage grows a p50/p90/p99 for free). ``exemplar`` is an
+        optional (trace_id, span_id) attached to the latency observation's
+        bucket (telemetry.Histogram exemplars): the pointer from a fleet
+        p99 back to the request trace that produced it."""
         with self._lock:
             st = self._stages.setdefault(stage, StageStats())
             st.records += records
@@ -93,7 +106,7 @@ class Metrics:
                 hist = self._hists.get(stage)
                 if hist is None:
                     hist = self._hists[stage] = Histogram()
-                hist.observe(latency)
+                hist.observe(latency, exemplar=exemplar)
 
     def count(self, stage: str, n: int = 1) -> None:
         """Increment a pure event counter (the ``records`` field carries the
@@ -163,15 +176,21 @@ class Metrics:
 
     # -- latency histograms --------------------------------------------------
 
-    def observe(self, stage: str, seconds: float) -> None:
+    def observe(
+        self,
+        stage: str,
+        seconds: float,
+        exemplar: Optional[Tuple[str, str]] = None,
+    ) -> None:
         """Fold one latency observation into ``stage``'s histogram without
         touching its throughput totals (for ops timed inline rather than
-        through ``timed``)."""
+        through ``timed``). ``exemplar`` optionally tags the observation's
+        bucket with a (trace_id, span_id) — see ``add``."""
         with self._lock:
             hist = self._hists.get(stage)
             if hist is None:
                 hist = self._hists[stage] = Histogram()
-            hist.observe(seconds)
+            hist.observe(seconds, exemplar=exemplar)
 
     def quantiles(self, prefix: Optional[str] = None) -> Dict[str, Dict[str, float]]:
         """Per-stage latency quantile snapshot (p50/p90/p99 seconds +

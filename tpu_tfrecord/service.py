@@ -1078,6 +1078,17 @@ class ServiceDispatcher:
             if not candidates:
                 return {"error": "no_workers"}
             wid = candidates[interleave_owner(shard_index, len(candidates))]
+            trace = msg.get("trace")
+            if isinstance(trace, dict):
+                # the dispatcher's half of the consumer's service.lease
+                # span: one instant linked by the lease span's id, so the
+                # merged timeline shows WHO routed this lease and when
+                telemetry.instant(
+                    "service.route",
+                    shard=shard_path, worker=wid,
+                    trace_id=trace.get("trace_id"),
+                    parent_span_id=trace.get("span_id"),
+                )
             prev = self._leases.get(key)
             reassigned = False
             if prev is not None and prev != wid:
@@ -1634,6 +1645,12 @@ class DecodeWorker:
                                "error": f"unknown shard {shard_path!r}"})
             return True
         METRICS.count("service.fetches")
+        # the worker's half of the consumer's service.lease span: the
+        # consumer ships its lease span id in the fetch message, and the
+        # service.serve span links back to it by parent_span_id — merged
+        # traces render route -> lease -> serve -> eof as one causal chain
+        trace = msg.get("trace")
+        trace = trace if isinstance(trace, dict) else None
         # Will this shard be served from the warm columnar cache (zero
         # ground-truth reads)? Peeked BEFORE the stream so the eof can
         # carry it to the consumer, which forwards it on shard_done —
@@ -1646,6 +1663,11 @@ class DecodeWorker:
         k = 0
         try:
             with telemetry.span("service.serve", shard=shard_path) as span:
+                if trace is not None:
+                    span.set(
+                        trace_id=trace.get("trace_id"),
+                        parent_span_id=trace.get("span_id"),
+                    )
                 for chunk, _e, _p, start in ds._decode_shard(0, 0, idx, skip):
                     nbytes = sp.send_chunk(conn, chunk, start, k)
                     k += 1
@@ -1817,33 +1839,49 @@ class ServiceClient:
         attempt = 0
         while not stop.is_set():
             wid = None
+            # one lease = one span: route -> lease -> serve -> eof, each
+            # attempt its own child of this process's context. The span id
+            # rides the route and fetch messages, so the dispatcher's
+            # service.route instant and the worker's service.serve span
+            # link back by parent_span_id in the merged timeline.
+            ctx = telemetry.current_context().child("service.lease")
             try:
-                reply = self._dispatcher_rpc(
-                    {
-                        "op": "route",
-                        "proto": PROTO_VERSION,
-                        "job": self._job,
-                        "tenant": self._tenant,
-                        "consumer": self._consumer_id,
-                        "path": shard.path,
-                        "shard_index": self._global_index[shard.path],
-                        "exclude": exclude,
-                    }
-                )
-                if reply.get("error"):
-                    raise ServiceUnavailable(str(reply["error"]))
-                worker_addr, wid = str(reply["worker"]), str(reply["worker_id"])
-                ttl = reply.get("lease_ttl_s")
-                if ttl is not None:
-                    self._suspect_ttl_s = float(ttl)
-                for item in self._fetch_shard(
-                    worker_addr, shard.path, consumed, epoch, pos, stop
-                ):
-                    yield item
-                    consumed = item[3] + item[0].num_rows
-                    budget_start = self._clock()  # progress resets the budget
-                    exclude = self._live_suspects()
-                    attempt = 0
+                with telemetry.span(
+                    "service.lease", shard=shard.path,
+                    trace_id=ctx.trace_id, span_id=ctx.span_id,
+                    parent_span_id=ctx.parent_span_id,
+                ) as lease:
+                    reply = self._dispatcher_rpc(
+                        {
+                            "op": "route",
+                            "proto": PROTO_VERSION,
+                            "job": self._job,
+                            "tenant": self._tenant,
+                            "consumer": self._consumer_id,
+                            "path": shard.path,
+                            "shard_index": self._global_index[shard.path],
+                            "exclude": exclude,
+                            "trace": ctx.to_json(),
+                        }
+                    )
+                    if reply.get("error"):
+                        raise ServiceUnavailable(str(reply["error"]))
+                    worker_addr, wid = (
+                        str(reply["worker"]), str(reply["worker_id"])
+                    )
+                    lease.set(worker=wid)
+                    ttl = reply.get("lease_ttl_s")
+                    if ttl is not None:
+                        self._suspect_ttl_s = float(ttl)
+                    for item in self._fetch_shard(
+                        worker_addr, shard.path, consumed, epoch, pos, stop,
+                        trace=ctx,
+                    ):
+                        yield item
+                        consumed = item[3] + item[0].num_rows
+                        budget_start = self._clock()  # progress resets the budget
+                        exclude = self._live_suspects()
+                        attempt = 0
                 # a suspect that just completed a shard for us is healthy
                 self._suspects.pop(wid, None)
                 self._shard_done(wid, shard.path, cached=self._fetch_cached)
@@ -1880,16 +1918,17 @@ class ServiceClient:
                     )
                 self._sleep(delay)
 
-    def _fetch_shard(self, worker_addr, shard_path, skip, epoch, pos, stop):
+    def _fetch_shard(self, worker_addr, shard_path, skip, epoch, pos, stop,
+                     trace=None):
         self._fetch_cached = False
         sock = sp.connect(worker_addr, timeout=self.deadline_s)
         try:
             sock.settimeout(self.deadline_s)
-            sp.send_msg(
-                sock,
-                {"op": "fetch", "proto": PROTO_VERSION, "spec": self._spec,
-                 "shard": shard_path, "skip": skip},
-            )
+            msg = {"op": "fetch", "proto": PROTO_VERSION, "spec": self._spec,
+                   "shard": shard_path, "skip": skip}
+            if trace is not None:
+                msg["trace"] = trace.to_json()
+            sp.send_msg(sock, msg)
             consumed = skip
             while not stop.is_set():
                 # EOF here (allow_eof=False) raises ProtocolError: a worker

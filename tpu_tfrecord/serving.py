@@ -147,9 +147,11 @@ class _Request:
     __slots__ = (
         "rid", "window", "n_new", "out", "deadline", "birth",
         "cancelled", "done", "status", "on_done",
+        "trace_id", "span_id", "parent_span_id", "first_pack",
     )
 
-    def __init__(self, rid, window, n_new, deadline, birth, on_done=None):
+    def __init__(self, rid, window, n_new, deadline, birth, on_done=None,
+                 ctx: Optional[_telemetry.TraceContext] = None):
         self.rid = rid
         self.window = window  # np [L] int32, slides as tokens generate
         self.n_new = n_new
@@ -160,6 +162,16 @@ class _Request:
         self.done = threading.Event()
         self.status: Optional[str] = None  # "ok"|"deadline_expired"|"cancelled"
         self.on_done = on_done
+        # request-scoped trace identity: the span the client minted for
+        # THIS request (or a locally minted child) — the serve.request
+        # root span records under these ids, and the latency exemplar
+        # points at them
+        self.trace_id = ctx.trace_id if ctx is not None else ""
+        self.span_id = ctx.span_id if ctx is not None else ""
+        self.parent_span_id = (
+            ctx.parent_span_id if ctx is not None else None
+        )
+        self.first_pack: Optional[float] = None  # engine clock, first slot claim
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         """Block until the request settles; the generated tokens, or the
@@ -171,6 +183,35 @@ class _Request:
         if self.status == "deadline_expired":
             raise DeadlineExpired(f"request {self.rid} missed its deadline")
         raise ServeRejected(f"request {self.rid} {self.status}")
+
+
+#: Synthetic Chrome-trace lane base for per-request spans: concurrent
+#: requests render as parallel tracks in Perfetto instead of overlapping
+#: X events on the engine thread's track. Lanes recycle mod 512 — far
+#: wider than any real in-flight set.
+_REQUEST_LANE_BASE = 1 << 22
+
+
+def _request_lane(rid: int) -> int:
+    return _REQUEST_LANE_BASE + rid % 512
+
+
+def _request_context(trace: Any) -> _telemetry.TraceContext:
+    """The request's trace identity: the TraceContext the client stamped
+    into the wire message (already a per-request child — ids propagate),
+    or a locally minted child of this process's context for direct
+    ``submit`` callers. A malformed wire payload degrades to the local
+    child — tracing never rejects a request."""
+    if isinstance(trace, _telemetry.TraceContext):
+        return trace
+    if isinstance(trace, dict):
+        try:
+            ctx = _telemetry.TraceContext.from_json(trace)
+            if ctx.trace_id and ctx.span_id:
+                return ctx
+        except (TypeError, ValueError):
+            pass
+    return _telemetry.current_context().child("serve.request")
 
 
 class ServingEngine:
@@ -220,13 +261,21 @@ class ServingEngine:
         n_new: int,
         deadline_s: Optional[float] = None,
         on_done: Optional[Callable[["_Request"], None]] = None,
+        trace: Any = None,
     ) -> _Request:
         """Admit one generation request (``window`` [L] int32, generate
         ``n_new`` tokens greedily) or refuse it LOUDLY: `ServeRejected`
         when the queue is at ``max_queue`` or the replica is draining
         (with a Retry-After hint), `DeadlineExpired` when the deadline is
         already unmeetable at admission. Never silently queues past
-        either bound."""
+        either bound.
+
+        ``trace`` is the request's trace identity — a TraceContext (or
+        its ``to_json`` dict, as shipped over the wire by `ServeClient`);
+        the ``serve.request`` root span and its children record under
+        those ids, and a shed/expiry at admission lands a ``serve.shed``/
+        ``serve.deadline_expired`` instant carrying the same trace id so
+        a refused request is still attributable in the merged timeline."""
         window = np.asarray(window, dtype=np.int32)
         if window.shape != (self.cfg.max_len,):
             raise ValueError(
@@ -234,27 +283,50 @@ class ServingEngine:
             )
         if n_new < 1:
             raise ValueError(f"n_new must be >= 1, got {n_new}")
+        tracing = _telemetry.RECORDER.enabled
+        ctx = _request_context(trace) if (tracing or trace is not None) else None
         now = self._clock()
         if deadline_s is None:
             deadline_s = self.policy.default_deadline_s
         deadline = None if deadline_s is None else now + deadline_s
         with self._cv:
             if self._draining or self._stop:
+                if tracing:
+                    _telemetry.record_instant(
+                        "serve.shed", int(now * 1e9),
+                        reason="draining",
+                        trace_id=ctx.trace_id, span_id=ctx.span_id,
+                    )
                 raise ServeRejected(
                     "replica draining", self.policy.hint(len(self._ready))
                 )
             if deadline is not None and deadline <= now:
                 self._metrics.count("serve.deadline_expired")
+                if tracing:
+                    _telemetry.record_instant(
+                        "serve.deadline_expired", int(now * 1e9),
+                        at="admission",
+                        trace_id=ctx.trace_id, span_id=ctx.span_id,
+                    )
                 raise DeadlineExpired("deadline expired at admission")
             if len(self._ready) >= self.policy.max_queue:
                 self._metrics.count("serve.rejected")
+                if tracing:
+                    _telemetry.record_instant(
+                        "serve.shed", int(now * 1e9),
+                        reason="queue_full",
+                        queue_depth=len(self._ready),
+                        trace_id=ctx.trace_id, span_id=ctx.span_id,
+                    )
                 raise ServeRejected(
                     f"queue full ({self.policy.max_queue})",
                     self.policy.hint(len(self._ready)),
                 )
             rid = self._next_rid
             self._next_rid += 1
-            req = _Request(rid, window, int(n_new), deadline, now, on_done)
+            req = _Request(
+                rid, window, int(n_new), deadline, now, on_done, ctx=ctx
+            )
             self._ready.append(req)
             self._metrics.gauge("serve.queue_depth", float(len(self._ready)))
             self._cv.notify_all()
@@ -274,9 +346,46 @@ class ServingEngine:
         req.status = status
         if status == "ok":
             self._metrics.count("serve.requests")
-            self._metrics.observe("serve.latency", now - req.birth)
+            exemplar = (
+                (req.trace_id, req.span_id) if req.trace_id else None
+            )
+            self._metrics.observe(
+                "serve.latency", now - req.birth, exemplar=exemplar
+            )
+            # the latency decomposition the bench probe reads: time spent
+            # waiting for a slot vs time being served (first pack ->
+            # completion). Both on the engine clock, both exemplar-tagged.
+            if req.first_pack is not None:
+                self._metrics.observe(
+                    "serve.queue_wait", req.first_pack - req.birth,
+                    exemplar=exemplar,
+                )
+                self._metrics.observe(
+                    "serve.service", now - req.first_pack,
+                    exemplar=exemplar,
+                )
         elif status == "deadline_expired":
             self._metrics.count("serve.deadline_expired")
+            if _telemetry.RECORDER.enabled and req.trace_id:
+                _telemetry.record_instant(
+                    "serve.deadline_expired", int(now * 1e9),
+                    tid=_request_lane(req.rid), at="tick", rid=req.rid,
+                    trace_id=req.trace_id, span_id=req.span_id,
+                )
+        if _telemetry.RECORDER.enabled and req.trace_id:
+            # THE request root span: admission -> completion on the
+            # engine's own (injectable) clock, so its duration equals the
+            # serve.latency observation exactly. span_id is the id the
+            # client minted — the client's spool and this replica's spool
+            # merge into one causal timeline per request.
+            _telemetry.record_span(
+                "serve.request", int(req.birth * 1e9),
+                int((now - req.birth) * 1e9),
+                tid=_request_lane(req.rid),
+                rid=req.rid, status=status, n_new=req.n_new,
+                trace_id=req.trace_id, span_id=req.span_id,
+                parent_span_id=req.parent_span_id,
+            )
         req.done.set()
         if req.on_done is not None:
             try:
@@ -305,6 +414,18 @@ class ServingEngine:
                     if req.deadline is not None and now > req.deadline:
                         self._finish(req, "deadline_expired", now)
                         continue
+                    if req.first_pack is None:
+                        req.first_pack = now
+                        if _telemetry.RECORDER.enabled and req.trace_id:
+                            # queue_wait closes the moment the request
+                            # first claims a slot: admission -> first pack
+                            _telemetry.record_span(
+                                "serve.queue_wait", int(req.birth * 1e9),
+                                int((now - req.birth) * 1e9),
+                                tid=_request_lane(req.rid), rid=req.rid,
+                                trace_id=req.trace_id,
+                                parent_span_id=req.span_id,
+                            )
                     slots.append(req)
             self._packed += len(slots)
             self._metrics.gauge("serve.queue_depth", float(len(self._ready)))
@@ -372,6 +493,23 @@ class ServingEngine:
         )
         self._metrics.count("serve.ticks")
         self._settle(self.stream.submit_tagged(tokens, tuple(slots)))
+        if _telemetry.RECORDER.enabled:
+            # one serve.tick slice per occupied slot, attributed to
+            # slot + request id and parented under the request span —
+            # the per-request timeline shows exactly which ticks (and
+            # which slot) served it
+            end = self._clock()
+            t0_ns = int(now * 1e9)
+            dur_ns = max(0, int((end - now) * 1e9))
+            for row, req in enumerate(slots):
+                if not req.trace_id:
+                    continue
+                _telemetry.record_span(
+                    "serve.tick", t0_ns, dur_ns,
+                    tid=_request_lane(req.rid),
+                    slot=row, rid=req.rid,
+                    trace_id=req.trace_id, parent_span_id=req.span_id,
+                )
         return len(slots)
 
     def run_until_idle(self) -> None:
@@ -461,6 +599,12 @@ class ServingEngine:
         p99 = q.get("p99_s")
         p50_ms = None if p50 is None else p50 * 1e3
         p99_ms = None if p99 is None else p99 * 1e3
+        qw = self._metrics.quantiles("serve.queue_wait").get(
+            "serve.queue_wait", {}
+        )
+        sv = self._metrics.quantiles("serve.service").get("serve.service", {})
+        qw99 = qw.get("p99_s")
+        sv99 = sv.get("p99_s")
         return {
             "role": "serving",
             "draining": draining,
@@ -471,6 +615,8 @@ class ServingEngine:
             "slo_p99_ms": self.policy.slo_p99_ms,
             "p50_ms": p50_ms,
             "p99_ms": p99_ms,
+            "queue_wait_p99_ms": None if qw99 is None else qw99 * 1e3,
+            "service_p99_ms": None if sv99 is None else sv99 * 1e3,
             "completed": q.get("count", 0),
             "counters": {
                 name: self._metrics.counter(name)
@@ -758,6 +904,7 @@ class ServeServer:
                 int(msg["n_new"]),
                 deadline_s=msg.get("deadline_s"),
                 on_done=on_done,
+                trace=msg.get("trace"),
             )
         except ServeRejected as e:
             conn.enqueue({
@@ -851,6 +998,12 @@ class ServeClient:
         `DeadlineExpired` (not retriable — late is late), `ServeRejected`
         when the budget exhausts against a saturated fleet."""
         self._next_req += 1
+        # one per-request trace child rides the wire: the replica records
+        # its serve.request root span under THIS span id (parented to the
+        # client's process root), so client + replica spools merge into
+        # one causal timeline per request. Extra message keys are
+        # protocol-legal; an old server ignores it.
+        ctx = _telemetry.current_context().child("serve.request")
         obj = {
             "v": sp.PROTO_VERSION,
             "op": "generate",
@@ -858,6 +1011,7 @@ class ServeClient:
             "tokens": np.asarray(window, dtype=np.int32).tolist(),
             "n_new": int(n_new),
             "deadline_s": deadline_s,
+            "trace": ctx.to_json(),
         }
         attempt, start = 0, self.policy.clock()
         while True:
@@ -910,14 +1064,22 @@ def run_server(
     role: str = "serving",
     install_signals: bool = True,
     ready_fh=None,
+    trace_out: Optional[str] = None,
 ) -> int:
     """Run a started server to completion: optionally announce readiness
     (one JSON line: addr + pid), land per-request telemetry on the fleet
     spool, and on SIGTERM/SIGINT drain gracefully — stop admitting,
     finish in-flight requests, write the spool's ``final: true`` snapshot
-    — then return 0. The scaler's drain RPC takes the same exit path."""
+    — then return 0. The scaler's drain RPC takes the same exit path.
+    ``trace_out`` turns the flight recorder on for the process lifetime
+    and saves the replica's Chrome trace (per-request ``serve.request``
+    timelines) there on exit — `tfrecord_doctor merge-trace` fuses it
+    with client-side traces."""
     from tpu_tfrecord import fleet as _fleet
 
+    if trace_out:
+        _telemetry.current_context()  # adopt an identity for the track label
+        _telemetry.enable()
     spool = None
     if spool_dir:
         spool = _fleet.acquire_spool(spool_dir, role=role, interval_s=0.2)
@@ -951,6 +1113,14 @@ def run_server(
     finally:
         if spool is not None:
             _fleet.release_spool(spool_dir)
+        if trace_out:
+            try:
+                _telemetry.RECORDER.save_chrome_trace(trace_out)
+            except OSError:
+                logger.exception(
+                    "tfrecord.serving could not save trace to %s", trace_out
+                )
+            _telemetry.disable()
     return 0
 
 
@@ -1002,6 +1172,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--role", default="serving")
     p.add_argument("--fault-plan", default=None,
                    help="path to a FaultPlan JSON (op='serve' rules)")
+    p.add_argument("--trace-out", default=None, metavar="FILE",
+                   help="record per-request spans and save the Chrome "
+                   "trace here on exit (merge-trace fuses it with client "
+                   "traces)")
     args = p.parse_args(argv)
 
     params, cfg, mesh = _build_synthetic(args)
@@ -1020,7 +1194,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ).start()
     return run_server(
         server, spool_dir=args.spool_dir, role=args.role,
-        ready_fh=sys.stdout,
+        ready_fh=sys.stdout, trace_out=args.trace_out,
     )
 
 

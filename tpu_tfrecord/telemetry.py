@@ -82,6 +82,8 @@ __all__ = [
     "adopt_from_env",
     "span",
     "instant",
+    "record_span",
+    "record_instant",
     "enable",
     "disable",
     "boundness_verdict",
@@ -122,7 +124,7 @@ class Histogram:
     _LOG2_GROWTH = 0.25  # buckets grow by 2**0.25 per step
     _NBUCKETS = 144  # 144 * 0.25 = 36 octaves above _MIN (~1.9 h)
 
-    __slots__ = ("counts", "count", "total", "min", "max")
+    __slots__ = ("counts", "count", "total", "min", "max", "exemplars")
 
     def __init__(self) -> None:
         self.counts = [0] * self._NBUCKETS
@@ -130,15 +132,35 @@ class Histogram:
         self.total = 0.0
         self.min = math.inf
         self.max = 0.0
+        # bucket index -> (trace_id, span_id, value): the LAST exemplar
+        # observed into that bucket. Bounded by construction (one entry
+        # per populated bucket, <= _NBUCKETS) and carried bucket-exactly
+        # through state()/merge_state() so fleet merges keep the pointer
+        # from a tail bucket to the trace that filled it.
+        self.exemplars: Dict[int, Tuple[str, str, float]] = {}
 
-    def observe(self, value: float) -> None:
+    def bucket_index(self, value: float) -> int:
         if value <= self._MIN:
-            idx = 0
-        else:
-            idx = min(
-                self._NBUCKETS - 1,
-                1 + int(math.log2(value / self._MIN) / self._LOG2_GROWTH),
-            )
+            return 0
+        return min(
+            self._NBUCKETS - 1,
+            1 + int(math.log2(value / self._MIN) / self._LOG2_GROWTH),
+        )
+
+    @classmethod
+    def bucket_le(cls, idx: int) -> float:
+        """Inclusive upper bound (seconds) of bucket ``idx`` — the ``le``
+        label when a bucket is rendered on a Prometheus page."""
+        if idx <= 0:
+            return cls._MIN
+        return cls._MIN * 2 ** (idx * cls._LOG2_GROWTH)
+
+    def observe(
+        self,
+        value: float,
+        exemplar: Optional[Tuple[str, str]] = None,
+    ) -> None:
+        idx = self.bucket_index(value)
         self.counts[idx] += 1
         self.count += 1
         self.total += value
@@ -146,6 +168,40 @@ class Histogram:
             self.min = value
         if value > self.max:
             self.max = value
+        if exemplar is not None:
+            self.exemplars[idx] = (
+                str(exemplar[0]), str(exemplar[1]), float(value)
+            )
+
+    def exemplar_at(self, q: float) -> Optional[Dict[str, Any]]:
+        """The exemplar nearest the quantile-``q`` bucket: the exemplar of
+        the highest populated bucket at or below where ``quantile(q)``
+        lands (tail observations overwrite last-wins, so for q near 1 this
+        is 'the trace that filled the top bucket'). None when no exemplar
+        was ever attached at or below that bucket."""
+        if self.count == 0 or not self.exemplars:
+            return None
+        rank = q * self.count
+        cum = 0
+        target = self._NBUCKETS - 1
+        for idx, c in enumerate(self.counts):
+            cum += c
+            if cum >= rank and c:
+                target = idx
+                break
+        best = None
+        for idx, ex in self.exemplars.items():
+            if idx <= target and (best is None or idx > best):
+                best = idx
+        if best is None:
+            return None
+        trace_id, span_id, value = self.exemplars[best]
+        return {
+            "bucket": best,
+            "trace_id": trace_id,
+            "span_id": span_id,
+            "value": value,
+        }
 
     def quantile(self, q: float) -> Optional[float]:
         """Estimated value at quantile ``q`` in [0, 1] (None when empty)."""
@@ -191,7 +247,7 @@ class Histogram:
         min/max. The layout params ride along so a merge across versions
         with a different bucket geometry fails loudly instead of blending
         incompatible buckets."""
-        return {
+        state: Dict[str, Any] = {
             "buckets": {
                 str(i): c for i, c in enumerate(self.counts) if c
             },
@@ -201,6 +257,14 @@ class Histogram:
             "max": self.max,
             "layout": [self._MIN, self._LOG2_GROWTH, self._NBUCKETS],
         }
+        if self.exemplars:
+            # omitted when empty: pre-exemplar snapshots and exemplar-free
+            # histograms serialize byte-identically to before
+            state["exemplars"] = {
+                str(i): [t, s, v]
+                for i, (t, s, v) in sorted(self.exemplars.items())
+            }
+        return state
 
     def merge_state(self, state: Dict[str, Any]) -> None:
         """Fold one ``state()`` snapshot in (exact: fixed shared buckets)."""
@@ -235,6 +299,21 @@ class Histogram:
         smax = state.get("max")
         if smax is not None and smax > self.max:
             self.max = smax
+        exemplars = state.get("exemplars") or {}
+        if not isinstance(exemplars, dict):
+            raise TypeError(
+                f"histogram exemplars must be a mapping, got "
+                f"{type(exemplars).__name__}"
+            )
+        for idx, ex in exemplars.items():
+            i = int(idx)
+            if not 0 <= i < self._NBUCKETS:
+                raise ValueError(f"exemplar bucket index out of range: {i}")
+            trace_id, span_id, value = ex
+            # last-wins across merge order; bucket COUNTS are untouched,
+            # so exemplar-carrying states merge to the same quantiles as
+            # exemplar-free ones
+            self.exemplars[i] = (str(trace_id), str(span_id), float(value))
 
     @classmethod
     def from_states(cls, states: Iterable[Dict[str, Any]]) -> "Histogram":
@@ -525,9 +604,20 @@ class SpanRecorder:
         self._record(name, time.perf_counter_ns(), 0, attrs or None, "i")
 
     def _record(
-        self, name: str, t0_ns: int, dur_ns: int, attrs: Optional[dict], ph: str
+        self,
+        name: str,
+        t0_ns: int,
+        dur_ns: int,
+        attrs: Optional[dict],
+        ph: str,
+        tid: Optional[int] = None,
     ) -> None:
-        tid = threading.get_ident()
+        # ``tid`` override: per-request spans (serving) record onto a
+        # synthetic lane per request id so concurrent requests render as
+        # parallel tracks in Perfetto instead of overlapping X events on
+        # one thread's track
+        if tid is None:
+            tid = threading.get_ident()
         with self._lock:
             seq = self._seq
             self._seq = seq + 1
@@ -661,14 +751,29 @@ def instant(name: str, **attrs) -> None:
         rec._record(name, time.perf_counter_ns(), 0, attrs or None, "i")
 
 
-def record_span(name: str, t0_ns: int, dur_ns: int, **attrs) -> None:
+def record_span(
+    name: str, t0_ns: int, dur_ns: int, tid: Optional[int] = None, **attrs
+) -> None:
     """Record an already-measured duration span — for callers that time a
     region manually and only know its extent after the fact (the
     consumer-side ``batch`` wait, which must not mark a terminal
-    StopIteration as a failed span)."""
+    StopIteration as a failed span). ``tid`` places the span on a
+    synthetic lane (serving's per-request tracks) instead of the calling
+    thread's."""
     rec = RECORDER
     if rec.enabled:
-        rec._record(name, t0_ns, dur_ns, attrs or None, "X")
+        rec._record(name, t0_ns, dur_ns, attrs or None, "X", tid=tid)
+
+
+def record_instant(
+    name: str, t0_ns: int, tid: Optional[int] = None, **attrs
+) -> None:
+    """Record a point event at an explicit timestamp (``instant`` stamps
+    now) — for shed/expiry markers that must land on the same clock and
+    lane as the request spans around them."""
+    rec = RECORDER
+    if rec.enabled:
+        rec._record(name, t0_ns, 0, attrs or None, "i", tid=tid)
 
 
 def enable() -> SpanRecorder:
@@ -1202,6 +1307,28 @@ def prometheus_text(metrics=None) -> str:
                 for name, q in sorted(metrics.quantiles().items())
             ),
         ),
+    )
+    # Exemplars as a dedicated gauge family (value = the exemplared
+    # observation, seconds) instead of OpenMetrics `# {...}` suffixes —
+    # the pinned text-format 0.0.4 parse of this page would reject the
+    # suffix syntax. `le` is the bucket's upper bound, so a tail sample
+    # here is clickable back to its trace/span ids.
+    family(
+        "tfrecord_latency_exemplar_seconds",
+        "gauge",
+        [
+            "tfrecord_latency_exemplar_seconds{"
+            f'stage="{escape_label_value(name)}",'
+            f'le="{Histogram.bucket_le(int(idx)):.6g}",'
+            f'trace_id="{escape_label_value(t)}",'
+            f'span_id="{escape_label_value(s)}"'
+            "} " + f"{v:.6g}"
+            for name, state in sorted(metrics.hist_states().items())
+            if is_latency_hist(name)
+            for idx, (t, s, v) in sorted(
+                (state.get("exemplars") or {}).items(), key=lambda kv: int(kv[0])
+            )
+        ],
     )
     return "\n".join(lines) + "\n"
 

@@ -168,8 +168,10 @@ STAGES: Dict[str, str] = {
     "moe.expert_imbalance": "max/mean routed tokens across experts",
     "pipeline.bubble_fraction": "pipeline schedule idle-tick fraction",
     "pipeline.bubble_fraction_v": "interleaved (V>1) schedule bubble fraction",
-    # streamed serving: a real latency histogram (not dimensionless)
+    # streamed serving: real latency histograms (not dimensionless)
     "serve.latency": "one serving request, admission -> last token",
+    "serve.queue_wait": "one request's admission queue wait, admission -> first pack",
+    "serve.service": "one request's service time, first pack -> last token",
 }
 
 #: Instantaneous gauges (``Metrics.gauge``): last write wins.
@@ -228,6 +230,14 @@ SPANS: Dict[str, str] = {
     "service.lease_reassigned": "an expired lease was re-routed",
     "service.failover": "a standby took over a partition (or adopted its address)",
     "service.demoted": "a primary stopped granting leases",
+    # request-scoped tracing (client-minted TraceContext over the wire)
+    "serve.request": "one serving request, admission -> completion (root span)",
+    "serve.queue_wait": "one request waiting for its first pack (child of serve.request)",
+    "serve.tick": "one scheduler tick's slice of one request (child of serve.request)",
+    "serve.shed": "a request was shed at admission (instant)",
+    "serve.deadline_expired": "a request's deadline fired (instant)",
+    "service.lease": "one consumer shard lease, route -> eof (root span)",
+    "service.route": "dispatcher routed a shard to a worker (instant, lease-linked)",
 }
 
 #: Prefixes under which names are formed at runtime and cannot be
@@ -237,6 +247,7 @@ DYNAMIC_PREFIXES: Dict[str, Dict[str, str]] = {
         "autotune.": "one gauge per tuned knob (workers, prefetch, ...)",
         "train.share.": "one gauge per train phase",
         "train.mesh.": "one gauge per mesh axis (extent)",
+        "slo.": "SLO engine state per objective kind (budget remaining, window burns)",
     },
     "stage": {
         "train.": "one stage per train phase",
